@@ -1,0 +1,37 @@
+"""alphatriangle_tpu — a TPU-native AlphaZero framework for the triangle puzzle.
+
+A ground-up JAX/XLA/Pallas redesign with the capability surface of the
+reference `lguibr/alphatriangle` stack (alphatriangle + trianglengin +
+trimcts + trieye), built TPU-first:
+
+- The game engine is a vectorized, fully-jittable JAX environment
+  (struct-of-arrays state, static shapes) instead of a per-game C++ object
+  (reference: trianglengin C++ core, see SURVEY.md §2b).
+- MCTS is a batched on-device tree search whose leaf evaluations batch
+  across *all* parallel games onto the MXU (reference: trimcts C++ with
+  per-worker CPU torch eval, SURVEY.md §3.2).
+- The learner is a pure-functional train step sharded over a
+  `jax.sharding.Mesh` with XLA collectives (reference: single-process
+  torch trainer, alphatriangle/rl/core/trainer.py).
+- Stats + persistence are an async host event bus with Orbax
+  checkpointing (reference: trieye Ray actor).
+"""
+
+__version__ = "0.1.0"
+
+from alphatriangle_tpu.config import (
+    AlphaTriangleMCTSConfig,
+    EnvConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+
+__all__ = [
+    "AlphaTriangleMCTSConfig",
+    "EnvConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "__version__",
+]

@@ -1,0 +1,26 @@
+"""Config package: pydantic models per concern (reference: alphatriangle/config)."""
+
+from alphatriangle_tpu.config.app_config import APP_NAME
+from alphatriangle_tpu.config.env_config import EnvConfig
+from alphatriangle_tpu.config.mcts_config import AlphaTriangleMCTSConfig, MCTSConfig
+from alphatriangle_tpu.config.mesh_config import MeshConfig
+from alphatriangle_tpu.config.model_config import ModelConfig
+from alphatriangle_tpu.config.persistence_config import PersistenceConfig
+from alphatriangle_tpu.config.train_config import TrainConfig
+from alphatriangle_tpu.config.validation import (
+    expected_other_features_dim,
+    print_config_info_and_validate,
+)
+
+__all__ = [
+    "APP_NAME",
+    "AlphaTriangleMCTSConfig",
+    "EnvConfig",
+    "MCTSConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "PersistenceConfig",
+    "TrainConfig",
+    "expected_other_features_dim",
+    "print_config_info_and_validate",
+]

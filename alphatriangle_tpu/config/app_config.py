@@ -1,0 +1,3 @@
+"""Application namespace constant (reference: `config/app_config.py:1`)."""
+
+APP_NAME = "AlphaTriangleTPU"

@@ -1,0 +1,93 @@
+"""Environment (game) configuration.
+
+Reconstruction of the `trianglengin.EnvConfig` surface observed in the
+reference (`tests/conftest.py:34-41`, `alphatriangle/nn/model.py:122-125`,
+`alphatriangle/features/extractor.py:25-118`): a triangular-lattice
+puzzle board described by ROWS x COLS cells, a per-row playable column
+range (cells outside it are permanent "death" cells), and
+NUM_SHAPE_SLOTS preview slots holding placeable shapes.
+
+The engine package itself is not vendored in the reference, so the rule
+constants below (rewards, clearable-line minimum, shape sizes) are this
+framework's documented reconstruction, kept configurable.
+
+Geometry conventions (used consistently by engine/features/models):
+- Cell (r, c) is an up-pointing triangle iff (r + c) is even.
+- An up cell shares edges with (r, c-1), (r, c+1), (r+1, c);
+  a down cell with (r, c-1), (r, c+1), (r-1, c).
+- Action encoding is the flat integer `slot * ROWS * COLS + r * COLS + c`
+  (reference: `alphatriangle/nn/model.py:122-125`).
+"""
+
+from pydantic import BaseModel, Field, model_validator
+
+
+def _default_playable_range() -> list[tuple[int, int]]:
+    # Symmetric hexagon-ish board on an 8x15 lattice: row r (and its
+    # mirror) exposes a contiguous window that widens toward the middle.
+    return [
+        (3, 12),
+        (2, 13),
+        (1, 14),
+        (0, 15),
+        (0, 15),
+        (1, 14),
+        (2, 13),
+        (3, 12),
+    ]
+
+
+class EnvConfig(BaseModel):
+    """Triangle puzzle environment config (pydantic, frozen)."""
+
+    model_config = {"frozen": True}
+
+    ROWS: int = Field(default=8, gt=0)
+    COLS: int = Field(default=15, gt=0)
+    # [start_col, end_col) playable window per row; everything else is a
+    # death cell (never playable, rendered -1.0 in the feature grid).
+    PLAYABLE_RANGE_PER_ROW: list[tuple[int, int]] = Field(
+        default_factory=_default_playable_range
+    )
+    NUM_SHAPE_SLOTS: int = Field(default=3, gt=0)
+
+    # --- Rule constants (reconstruction; configurable) ---
+    # Largest shape in the bank, in triangles. The reference's feature
+    # extractor normalizes triangle count by 5 (`features/extractor.py:70`).
+    MAX_SHAPE_TRIANGLES: int = Field(default=5, ge=1, le=8)
+    MIN_SHAPE_TRIANGLES: int = Field(default=1, ge=1)
+    # A maximal line (horizontal / both lattice diagonals) is clearable
+    # only if it spans at least this many cells.
+    LINE_MIN_LENGTH: int = Field(default=3, ge=2)
+    # Rewards: placement pays per triangle placed, clears pay per
+    # triangle cleared, and ending the game costs a flat penalty.
+    REWARD_PER_PLACED_TRIANGLE: float = Field(default=1.0)
+    REWARD_PER_CLEARED_TRIANGLE: float = Field(default=2.0)
+    PENALTY_GAME_OVER: float = Field(default=-10.0)
+    # Number of distinct shape colors (cosmetic; carried in color_id).
+    NUM_COLORS: int = Field(default=7, ge=1)
+
+    @model_validator(mode="after")
+    def _check_ranges(self) -> "EnvConfig":
+        if len(self.PLAYABLE_RANGE_PER_ROW) != self.ROWS:
+            raise ValueError(
+                f"PLAYABLE_RANGE_PER_ROW must have ROWS={self.ROWS} entries, "
+                f"got {len(self.PLAYABLE_RANGE_PER_ROW)}."
+            )
+        for r, (lo, hi) in enumerate(self.PLAYABLE_RANGE_PER_ROW):
+            if not (0 <= lo < hi <= self.COLS):
+                raise ValueError(
+                    f"Row {r}: playable range ({lo}, {hi}) must satisfy "
+                    f"0 <= start < end <= COLS={self.COLS}."
+                )
+        if self.MIN_SHAPE_TRIANGLES > self.MAX_SHAPE_TRIANGLES:
+            raise ValueError("MIN_SHAPE_TRIANGLES must be <= MAX_SHAPE_TRIANGLES.")
+        return self
+
+    @property
+    def action_dim(self) -> int:
+        """Flat action-space size: NUM_SHAPE_SLOTS * ROWS * COLS."""
+        return self.NUM_SHAPE_SLOTS * self.ROWS * self.COLS
+
+
+EnvConfig.model_rebuild(force=True)

@@ -1,0 +1,39 @@
+"""MCTS search configuration.
+
+Parity with `trimcts.SearchConfiguration` as mirrored by the reference's
+`AlphaTriangleMCTSConfig` (`alphatriangle/config/mcts_config.py:10-77`).
+
+The TPU search evaluates one leaf per parallel game per simulation, so
+`mcts_batch_size` (the reference's C++ leaf-collection size,
+`mcts_config.py:57-62`) is kept for config parity but the effective
+MXU batch is SELF_PLAY_BATCH_SIZE games wide.
+"""
+
+from pydantic import BaseModel, Field, model_validator
+
+
+class AlphaTriangleMCTSConfig(BaseModel):
+    """PUCT search hyperparameters (pydantic)."""
+
+    max_simulations: int = Field(default=64, gt=0)
+    max_depth: int = Field(default=8, gt=0)
+    cpuct: float = Field(default=1.5, gt=0)
+    dirichlet_alpha: float = Field(default=0.3, gt=0)
+    dirichlet_epsilon: float = Field(default=0.25, ge=0, le=1.0)
+    discount: float = Field(default=1.0, gt=0, le=1.0)
+    # Parity knob (see module docstring); not a TPU batching control.
+    mcts_batch_size: int = Field(default=32, gt=0)
+
+    @model_validator(mode="after")
+    def _check(self) -> "AlphaTriangleMCTSConfig":
+        if self.max_depth > self.max_simulations + 1:
+            # Deeper than the number of expansions is harmless but
+            # wastes fixed-size path buffers in the jitted search.
+            pass
+        return self
+
+
+# Short alias used throughout this package.
+MCTSConfig = AlphaTriangleMCTSConfig
+
+AlphaTriangleMCTSConfig.model_rebuild(force=True)

@@ -1,0 +1,75 @@
+"""Device-mesh / parallelism configuration (no reference equivalent).
+
+The reference has no collective backend at all — its learner is a single
+torch device and its "distribution" is Ray actor RPC (SURVEY.md §2c).
+Here the parallelism story is first-class: a `jax.sharding.Mesh` with
+named axes, over which the learner train step and the self-play
+inference path are pjit-sharded. XLA inserts the ICI collectives.
+
+Axes:
+- "dp": data parallel (batch sharding, psum of grads).
+- "mdl": model parallel (tensor sharding of wide layers; size 1 by
+  default — the flagship net is ~3M params — but the sharding rules are
+  written against this axis so scaling it up requires no code change).
+"""
+
+import math
+from typing import Literal
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+from pydantic import BaseModel, Field
+
+
+class MeshConfig(BaseModel):
+    """Mesh shape + axis names for pjit sharding."""
+
+    # -1 means "all remaining devices" on the dp axis.
+    DP_SIZE: int = Field(default=-1)
+    MDL_SIZE: int = Field(default=1, ge=1)
+    DP_AXIS: str = Field(default="dp")
+    MDL_AXIS: str = Field(default="mdl")
+    # Which JAX platform to build the mesh on ("auto" = default backend).
+    PLATFORM: Literal["auto", "tpu", "cpu"] = Field(default="auto")
+
+    def resolve_dp_size(self, n_devices: int) -> int:
+        if self.DP_SIZE == -1:
+            if n_devices % self.MDL_SIZE != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by MDL_SIZE={self.MDL_SIZE}"
+                )
+            return n_devices // self.MDL_SIZE
+        return self.DP_SIZE
+
+    def build_mesh(self, devices: list | None = None) -> Mesh:
+        """Construct the (dp, mdl) mesh over the available devices."""
+        if devices is None:
+            devices = (
+                jax.devices()
+                if self.PLATFORM == "auto"
+                else jax.devices(self.PLATFORM)
+            )
+        dp = self.resolve_dp_size(len(devices))
+        needed = dp * self.MDL_SIZE
+        if needed > len(devices):
+            raise ValueError(
+                f"Mesh needs {needed} devices (dp={dp} x mdl={self.MDL_SIZE}), "
+                f"only {len(devices)} available."
+            )
+        grid = np.asarray(devices[:needed]).reshape(dp, self.MDL_SIZE)
+        return Mesh(grid, (self.DP_AXIS, self.MDL_AXIS))
+
+    @staticmethod
+    def single_device_mesh() -> Mesh:
+        """A 1x1 mesh on the default device (works everywhere)."""
+        dev = np.asarray(jax.devices()[:1]).reshape(1, 1)
+        return Mesh(dev, ("dp", "mdl"))
+
+
+def largest_pow2_leq(n: int) -> int:
+    """Largest power of two <= n (mesh sizing helper)."""
+    return 1 << int(math.log2(n)) if n >= 1 else 1
+
+
+MeshConfig.model_rebuild(force=True)

@@ -1,0 +1,92 @@
+"""Neural-network architecture configuration.
+
+Capability parity with the reference `ModelConfig`
+(`alphatriangle/config/model_config.py:17-59`): conv trunk, residual
+blocks, optional transformer encoder, shared FC, policy head, C51
+distributional value head. TPU-specific additions: compute dtype
+(bfloat16 on MXU), rematerialization, and a norm choice that defaults to
+GroupNorm — BatchNorm cross-example state is hostile to pjit sharding,
+so it is supported but not the default.
+"""
+
+from typing import Literal
+
+from pydantic import BaseModel, Field, model_validator
+
+
+class ModelConfig(BaseModel):
+    """Policy/value network hyperparameters (pydantic)."""
+
+    GRID_INPUT_CHANNELS: int = Field(default=1, gt=0)
+
+    # --- CNN trunk ---
+    CONV_FILTERS: list[int] = Field(default=[32, 64, 128])
+    CONV_KERNEL_SIZES: list[int] = Field(default=[3, 3, 3])
+    CONV_STRIDES: list[int] = Field(default=[1, 1, 1])
+
+    # --- Residual blocks ---
+    NUM_RESIDUAL_BLOCKS: int = Field(default=2, ge=0)
+    RESIDUAL_BLOCK_FILTERS: int = Field(default=128, gt=0)
+
+    # --- Optional transformer encoder over the spatial sequence ---
+    USE_TRANSFORMER: bool = Field(default=True)
+    TRANSFORMER_DIM: int = Field(default=128, gt=0)
+    TRANSFORMER_HEADS: int = Field(default=4, gt=0)
+    TRANSFORMER_LAYERS: int = Field(default=2, ge=0)
+    TRANSFORMER_FC_DIM: int = Field(default=256, gt=0)
+
+    # --- Heads ---
+    FC_DIMS_SHARED: list[int] = Field(default=[128])
+    POLICY_HEAD_DIMS: list[int] = Field(default=[128])
+    VALUE_HEAD_DIMS: list[int] = Field(default=[128])
+
+    # --- Distributional (C51) value head ---
+    NUM_VALUE_ATOMS: int = Field(default=51, gt=1)
+    VALUE_MIN: float = Field(default=-10.0)
+    VALUE_MAX: float = Field(default=10.0)
+
+    # --- Misc ---
+    ACTIVATION_FUNCTION: Literal["ReLU", "GELU", "SiLU", "Tanh", "Sigmoid"] = Field(
+        default="ReLU"
+    )
+    # Norm layer. "batch" matches the reference (`model_config.py:54`) but
+    # carries running statistics; "group" is stateless and shards cleanly.
+    NORM_TYPE: Literal["group", "layer", "batch", "none"] = Field(default="group")
+    USE_BATCH_NORM: bool = Field(default=True)  # parity alias; see NORM_TYPE
+
+    OTHER_NN_INPUT_FEATURES_DIM: int = Field(default=30, gt=0)
+
+    # --- TPU-specific ---
+    COMPUTE_DTYPE: Literal["bfloat16", "float32"] = Field(default="bfloat16")
+    PARAM_DTYPE: Literal["float32"] = Field(default="float32")
+    # jax.checkpoint the residual + transformer blocks to trade FLOPs for HBM.
+    REMAT: bool = Field(default=False)
+
+    @model_validator(mode="after")
+    def _check_conv_consistency(self) -> "ModelConfig":
+        n = len(self.CONV_FILTERS)
+        if len(self.CONV_KERNEL_SIZES) != n or len(self.CONV_STRIDES) != n:
+            raise ValueError(
+                "CONV_FILTERS, CONV_KERNEL_SIZES and CONV_STRIDES must have "
+                "matching lengths."
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _check_transformer(self) -> "ModelConfig":
+        if self.USE_TRANSFORMER and self.TRANSFORMER_LAYERS > 0:
+            if self.TRANSFORMER_DIM % self.TRANSFORMER_HEADS != 0:
+                raise ValueError(
+                    f"TRANSFORMER_DIM ({self.TRANSFORMER_DIM}) must be divisible "
+                    f"by TRANSFORMER_HEADS ({self.TRANSFORMER_HEADS})."
+                )
+        return self
+
+    @model_validator(mode="after")
+    def _check_value_support(self) -> "ModelConfig":
+        if self.VALUE_MIN >= self.VALUE_MAX:
+            raise ValueError("VALUE_MIN must be strictly less than VALUE_MAX.")
+        return self
+
+
+ModelConfig.model_rebuild(force=True)

@@ -1,0 +1,66 @@
+"""Run-directory + persistence configuration.
+
+Equivalent of trieye's `PersistenceConfig` as used by the reference
+(`alphatriangle/cli.py:165-172`, SURVEY.md §2b trieye row): where runs
+live, whether/how often the replay buffer is spilled to disk, and where
+MLflow/TensorBoard artifacts go. Layout mirrors the reference's
+`.trieye_data/<app>/runs/<run>/{checkpoints,buffers,logs,tensorboard,
+profile_data}` tree (reference README.md:63-79).
+"""
+
+from pathlib import Path
+
+from pydantic import BaseModel, Field
+
+from alphatriangle_tpu.config.app_config import APP_NAME
+
+
+class PersistenceConfig(BaseModel):
+    """Filesystem layout + save cadences for a training run."""
+
+    APP_NAME: str = Field(default=APP_NAME)
+    RUN_NAME: str = Field(default="default_run")
+    ROOT_DATA_DIR: str = Field(default=".alphatriangle_data")
+    SAVE_BUFFER: bool = Field(default=True)
+    BUFFER_SAVE_FREQ_STEPS: int = Field(default=10_000, ge=1)
+    MLFLOW_TRACKING_URI: str | None = Field(default=None)
+
+    def get_app_root_dir(self) -> Path:
+        return Path(self.ROOT_DATA_DIR) / self.APP_NAME
+
+    def get_runs_root_dir(self) -> Path:
+        return self.get_app_root_dir() / "runs"
+
+    def get_run_base_dir(self) -> Path:
+        return self.get_runs_root_dir() / self.RUN_NAME
+
+    def get_checkpoint_dir(self) -> Path:
+        return self.get_run_base_dir() / "checkpoints"
+
+    def get_buffer_dir(self) -> Path:
+        return self.get_run_base_dir() / "buffers"
+
+    def get_log_dir(self) -> Path:
+        return self.get_run_base_dir() / "logs"
+
+    def get_tensorboard_dir(self) -> Path:
+        return self.get_run_base_dir() / "tensorboard"
+
+    def get_profile_dir(self) -> Path:
+        return self.get_run_base_dir() / "profile_data"
+
+    def get_mlflow_abs_path(self) -> str:
+        return str((self.get_app_root_dir() / "mlruns").resolve())
+
+    def create_run_dirs(self) -> None:
+        for d in (
+            self.get_checkpoint_dir(),
+            self.get_buffer_dir(),
+            self.get_log_dir(),
+            self.get_tensorboard_dir(),
+            self.get_profile_dir(),
+        ):
+            d.mkdir(parents=True, exist_ok=True)
+
+
+PersistenceConfig.model_rebuild(force=True)

@@ -1,0 +1,138 @@
+"""Training-loop configuration.
+
+Capability parity with the reference `TrainConfig`
+(`alphatriangle/config/train_config.py:18-103`): loop length, batching,
+n-step returns, optimizer/scheduler, loss weights, checkpoint cadence,
+PER knobs, profiling. TPU-specific additions replace the reference's
+per-worker-CPU knobs with on-device self-play sizing: the number of
+games stepped in parallel on the accelerator and the rollout chunk
+length per dispatch.
+"""
+
+import time
+from typing import Literal
+
+from pydantic import BaseModel, Field, field_validator, model_validator
+
+
+class TrainConfig(BaseModel):
+    """Training hyperparameters (pydantic)."""
+
+    RUN_NAME: str = Field(
+        default_factory=lambda: f"train_{time.strftime('%Y%m%d_%H%M%S')}"
+    )
+    LOAD_CHECKPOINT_PATH: str | None = Field(default=None)
+    LOAD_BUFFER_PATH: str | None = Field(default=None)
+    AUTO_RESUME_LATEST: bool = Field(default=True)
+    RANDOM_SEED: int = Field(default=42)
+
+    # --- Loop ---
+    MAX_TRAINING_STEPS: int | None = Field(default=100_000, ge=1)
+
+    # --- Self-play (TPU-native: games batched on device, not Ray actors) ---
+    # Number of games stepped in lockstep per device dispatch. This is
+    # the MCTS leaf-eval batch seen by the MXU (replaces the reference's
+    # NUM_SELF_PLAY_WORKERS x mcts_batch_size CPU batching).
+    SELF_PLAY_BATCH_SIZE: int = Field(default=512, ge=1)
+    # Moves played per jitted rollout dispatch before results return to host.
+    ROLLOUT_CHUNK_MOVES: int = Field(default=16, ge=1)
+    # Parity alias for the reference knob: host-side actor threads that
+    # each drive an independent rollout stream (overlap host/device work).
+    NUM_SELF_PLAY_WORKERS: int = Field(default=1, ge=1)
+    WORKER_UPDATE_FREQ_STEPS: int = Field(default=10, ge=1)
+    # Hard cap on moves per episode (safety net for jitted rollouts).
+    MAX_EPISODE_MOVES: int = Field(default=1000, ge=1)
+
+    # --- Batching / buffer ---
+    BATCH_SIZE: int = Field(default=256, ge=1)
+    BUFFER_CAPACITY: int = Field(default=250_000, ge=1)
+    MIN_BUFFER_SIZE_TO_TRAIN: int = Field(default=25_000, ge=1)
+
+    # --- N-step returns ---
+    N_STEP_RETURNS: int = Field(default=5, ge=1)
+    GAMMA: float = Field(default=0.99, gt=0, le=1.0)
+
+    # --- Optimizer ---
+    OPTIMIZER_TYPE: Literal["Adam", "AdamW", "SGD"] = Field(default="AdamW")
+    LEARNING_RATE: float = Field(default=2e-4, gt=0)
+    WEIGHT_DECAY: float = Field(default=1e-4, ge=0)
+    GRADIENT_CLIP_VALUE: float | None = Field(default=1.0)
+
+    # --- LR schedule ---
+    LR_SCHEDULER_TYPE: Literal["StepLR", "CosineAnnealingLR"] | None = Field(
+        default="CosineAnnealingLR"
+    )
+    LR_SCHEDULER_T_MAX: int | None = Field(default=None)
+    LR_SCHEDULER_ETA_MIN: float = Field(default=1e-6, ge=0)
+    LR_SCHEDULER_STEP_SIZE: int = Field(default=10_000, ge=1)
+    LR_SCHEDULER_GAMMA: float = Field(default=0.5, gt=0, le=1.0)
+
+    # --- Loss weights ---
+    POLICY_LOSS_WEIGHT: float = Field(default=1.0, ge=0)
+    VALUE_LOSS_WEIGHT: float = Field(default=1.0, ge=0)
+    ENTROPY_BONUS_WEIGHT: float = Field(default=0.001, ge=0)
+
+    # --- Checkpointing ---
+    CHECKPOINT_SAVE_FREQ_STEPS: int = Field(default=2500, ge=1)
+
+    # --- PER ---
+    USE_PER: bool = Field(default=True)
+    PER_ALPHA: float = Field(default=0.6, ge=0)
+    PER_BETA_INITIAL: float = Field(default=0.4, ge=0, le=1.0)
+    PER_BETA_FINAL: float = Field(default=1.0, ge=0, le=1.0)
+    PER_BETA_ANNEAL_STEPS: int | None = Field(default=None)
+    PER_EPSILON: float = Field(default=1e-5, gt=0)
+
+    # --- Temperature schedule for action selection (move-indexed) ---
+    TEMPERATURE_INITIAL: float = Field(default=1.0, ge=0)
+    TEMPERATURE_FINAL: float = Field(default=0.1, ge=0)
+    TEMPERATURE_ANNEAL_MOVES: int = Field(default=30, ge=1)
+
+    # --- Device / compile (parity surface; JAX jits everything anyway) ---
+    DEVICE: Literal["auto", "tpu", "cpu"] = Field(default="auto")
+    WORKER_DEVICE: Literal["auto", "tpu", "cpu"] = Field(default="auto")
+    COMPILE_MODEL: bool = Field(default=True)
+
+    # --- Profiling ---
+    PROFILE_WORKERS: bool = Field(default=False)
+
+    @model_validator(mode="after")
+    def _check_buffer_sizes(self) -> "TrainConfig":
+        if self.MIN_BUFFER_SIZE_TO_TRAIN > self.BUFFER_CAPACITY:
+            raise ValueError(
+                "MIN_BUFFER_SIZE_TO_TRAIN cannot be greater than BUFFER_CAPACITY."
+            )
+        if self.BATCH_SIZE > self.BUFFER_CAPACITY:
+            raise ValueError("BATCH_SIZE cannot be greater than BUFFER_CAPACITY.")
+        return self
+
+    @model_validator(mode="after")
+    def _derive_schedule_lengths(self) -> "TrainConfig":
+        # Auto-derive cosine horizon and PER beta anneal from the run
+        # length, as the reference does (`train_config.py:131-209`).
+        horizon = self.MAX_TRAINING_STEPS or 100_000
+        if self.LR_SCHEDULER_TYPE == "CosineAnnealingLR" and self.LR_SCHEDULER_T_MAX is None:
+            self.LR_SCHEDULER_T_MAX = horizon
+        if self.USE_PER and self.PER_BETA_ANNEAL_STEPS is None:
+            self.PER_BETA_ANNEAL_STEPS = horizon
+        if self.LR_SCHEDULER_T_MAX is not None and self.LR_SCHEDULER_T_MAX <= 0:
+            raise ValueError("LR_SCHEDULER_T_MAX must be positive if set.")
+        if self.PER_BETA_ANNEAL_STEPS is not None and self.PER_BETA_ANNEAL_STEPS <= 0:
+            raise ValueError("PER_BETA_ANNEAL_STEPS must be positive if set.")
+        return self
+
+    @field_validator("GRADIENT_CLIP_VALUE")
+    @classmethod
+    def _check_grad_clip(cls, v: float | None) -> float | None:
+        if v is not None and v <= 0:
+            raise ValueError("GRADIENT_CLIP_VALUE must be positive if set.")
+        return v
+
+    @model_validator(mode="after")
+    def _check_beta(self) -> "TrainConfig":
+        if self.PER_BETA_FINAL < self.PER_BETA_INITIAL:
+            raise ValueError("PER_BETA_FINAL cannot be less than PER_BETA_INITIAL.")
+        return self
+
+
+TrainConfig.model_rebuild(force=True)

@@ -1,0 +1,82 @@
+"""Startup config validation (reference: `config/validation.py:20-101`).
+
+Instantiates every config model, checks cross-config invariants that
+pydantic cannot see (feature dim vs slots, action dim vs heads), prints
+a summary, and raises on any failure.
+"""
+
+import logging
+
+from alphatriangle_tpu.config.env_config import EnvConfig
+from alphatriangle_tpu.config.mcts_config import AlphaTriangleMCTSConfig
+from alphatriangle_tpu.config.mesh_config import MeshConfig
+from alphatriangle_tpu.config.model_config import ModelConfig
+from alphatriangle_tpu.config.persistence_config import PersistenceConfig
+from alphatriangle_tpu.config.train_config import TrainConfig
+
+logger = logging.getLogger(__name__)
+
+# Feature layout constants shared with features/ (see features.core).
+FEATURES_PER_SHAPE = 7
+EXPLICIT_FEATURES_DIM = 6
+
+
+def expected_other_features_dim(env: EnvConfig) -> int:
+    """Per-slot shape feats + slot availability + scalar feats."""
+    return env.NUM_SHAPE_SLOTS * FEATURES_PER_SHAPE + env.NUM_SHAPE_SLOTS + (
+        EXPLICIT_FEATURES_DIM
+    )
+
+
+def print_config_info_and_validate(
+    env: EnvConfig | None = None,
+    model: ModelConfig | None = None,
+    train: TrainConfig | None = None,
+    mcts: AlphaTriangleMCTSConfig | None = None,
+    mesh: MeshConfig | None = None,
+    persistence: PersistenceConfig | None = None,
+) -> dict:
+    """Validate all configs together; returns them as a dict."""
+    env = env or EnvConfig()
+    model = model or ModelConfig()
+    train = train or TrainConfig()
+    mcts = mcts or AlphaTriangleMCTSConfig()
+    mesh = mesh or MeshConfig()
+    persistence = persistence or PersistenceConfig()
+
+    expected_dim = expected_other_features_dim(env)
+    if model.OTHER_NN_INPUT_FEATURES_DIM != expected_dim:
+        raise ValueError(
+            f"ModelConfig.OTHER_NN_INPUT_FEATURES_DIM="
+            f"{model.OTHER_NN_INPUT_FEATURES_DIM} does not match the feature "
+            f"layout for NUM_SHAPE_SLOTS={env.NUM_SHAPE_SLOTS}: expected "
+            f"{expected_dim} ({env.NUM_SHAPE_SLOTS}x{FEATURES_PER_SHAPE} shape "
+            f"+ {env.NUM_SHAPE_SLOTS} availability + {EXPLICIT_FEATURES_DIM} scalars)."
+        )
+
+    logger.info(
+        "Config OK: board %dx%d (%d slots, action_dim=%d), net %s conv=%s "
+        "transformer=%s params-dtype=%s, train batch=%d buffer=%d per=%s, "
+        "mcts sims=%d depth=%d",
+        env.ROWS,
+        env.COLS,
+        env.NUM_SHAPE_SLOTS,
+        env.action_dim,
+        model.ACTIVATION_FUNCTION,
+        model.CONV_FILTERS,
+        model.USE_TRANSFORMER and model.TRANSFORMER_LAYERS,
+        model.PARAM_DTYPE,
+        train.BATCH_SIZE,
+        train.BUFFER_CAPACITY,
+        train.USE_PER,
+        mcts.max_simulations,
+        mcts.max_depth,
+    )
+    return {
+        "env": env,
+        "model": model,
+        "train": train,
+        "mcts": mcts,
+        "mesh": mesh,
+        "persistence": persistence,
+    }

@@ -1,0 +1,65 @@
+"""Root logging setup (reference: `alphatriangle/logging_config.py:10-104`).
+
+Colored, `▲`-prefixed console formatter; optional file handler;
+third-party noise clamps (jax/orbax/absl to WARNING).
+"""
+
+import logging
+import sys
+from pathlib import Path
+
+RESET = "\x1b[0m"
+COLORS = {
+    logging.DEBUG: "\x1b[36m",  # cyan
+    logging.INFO: "\x1b[32m",  # green
+    logging.WARNING: "\x1b[33m",  # yellow
+    logging.ERROR: "\x1b[31m",  # red
+    logging.CRITICAL: "\x1b[41m",  # red background
+}
+
+
+class TriangleFormatter(logging.Formatter):
+    """`▲ [LEVEL] name: msg` with per-level ANSI color."""
+
+    def __init__(self, use_color: bool = True):
+        super().__init__()
+        self.use_color = use_color
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = (
+            f"▲ [{record.levelname}] {record.name}: {record.getMessage()}"
+        )
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        if self.use_color and sys.stderr.isatty():
+            color = COLORS.get(record.levelno, "")
+            return f"{color}{base}{RESET}"
+        return base
+
+
+def setup_logging(
+    level: int | str = logging.INFO, log_file: str | Path | None = None
+) -> None:
+    """Configure the root logger (idempotent: clears prior handlers)."""
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+
+    console = logging.StreamHandler(sys.stderr)
+    console.setFormatter(TriangleFormatter())
+    root.addHandler(console)
+
+    if log_file is not None:
+        Path(log_file).parent.mkdir(parents=True, exist_ok=True)
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(
+            logging.Formatter("%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+        )
+        root.addHandler(fh)
+
+    # Clamp noisy third-party loggers (reference clamps ray/trimcts).
+    for noisy in ("jax", "jax._src", "absl", "orbax", "etils", "numba"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)

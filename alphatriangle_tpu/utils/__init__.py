@@ -1,0 +1,37 @@
+"""Utility layer: types, sum tree, helpers, geometry."""
+
+from alphatriangle_tpu.utils.geometry import is_point_in_polygon
+from alphatriangle_tpu.utils.helpers import (
+    format_eta,
+    get_device,
+    normalize_color_for_matplotlib,
+    set_random_seeds,
+)
+from alphatriangle_tpu.utils.sumtree import SumTree
+from alphatriangle_tpu.utils.types import (
+    ActionType,
+    DenseBatch,
+    Experience,
+    PERBatchSample,
+    PolicyTargetMapping,
+    StateType,
+    dense_policy_from_mapping,
+    mapping_from_dense_policy,
+)
+
+__all__ = [
+    "ActionType",
+    "DenseBatch",
+    "Experience",
+    "PERBatchSample",
+    "PolicyTargetMapping",
+    "StateType",
+    "SumTree",
+    "dense_policy_from_mapping",
+    "format_eta",
+    "get_device",
+    "is_point_in_polygon",
+    "mapping_from_dense_policy",
+    "normalize_color_for_matplotlib",
+    "set_random_seeds",
+]

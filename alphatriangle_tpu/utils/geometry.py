@@ -1,0 +1,30 @@
+"""Planar geometry helpers (reference: `alphatriangle/utils/geometry.py:1-44`).
+
+Kept for visualization tooling; not on the training path.
+"""
+
+
+def is_point_in_polygon(
+    point: tuple[float, float], polygon: list[tuple[float, float]]
+) -> bool:
+    """Ray-casting point-in-polygon test (boundary counts as inside)."""
+    x, y = point
+    n = len(polygon)
+    if n < 3:
+        return False
+    inside = False
+    j = n - 1
+    for i in range(n):
+        xi, yi = polygon[i]
+        xj, yj = polygon[j]
+        # On-vertex / on-edge quick accept.
+        if (xi, yi) == (x, y):
+            return True
+        if (yi > y) != (yj > y):
+            x_cross = (xj - xi) * (y - yi) / (yj - yi) + xi
+            if abs(x - x_cross) < 1e-12:
+                return True
+            if x < x_cross:
+                inside = not inside
+        j = i
+    return inside

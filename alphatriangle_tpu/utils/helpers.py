@@ -1,0 +1,51 @@
+"""Small shared helpers (reference: `alphatriangle/utils/helpers.py:12-108`)."""
+
+import logging
+import random
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def get_device(preference: str = "auto") -> jax.Device:
+    """Pick the compute device: TPU > GPU > CPU (reference picked CUDA>MPS>CPU)."""
+    if preference not in ("auto", "tpu", "gpu", "cpu"):
+        raise ValueError(f"unknown device preference: {preference}")
+    if preference != "auto":
+        devs = jax.devices(preference) if preference != "tpu" else [
+            d for d in jax.devices() if d.platform != "cpu"
+        ] or jax.devices()
+        return devs[0]
+    return jax.devices()[0]
+
+
+def set_random_seeds(seed: int) -> jax.Array:
+    """Seed python/numpy and return the root JAX PRNG key.
+
+    JAX randomness is functional: unlike the reference's global
+    torch/cuda seeding (`helpers.py:51-77`), all device-side randomness
+    flows from this key explicitly.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def format_eta(seconds: float | None) -> str:
+    """Seconds → 'Xd HH:MM:SS' (reference: `helpers.py:80-95`)."""
+    if seconds is None or not np.isfinite(seconds) or seconds < 0:
+        return "N/A"
+    seconds = int(seconds)
+    days, rem = divmod(seconds, 86400)
+    hours, rem = divmod(rem, 3600)
+    minutes, secs = divmod(rem, 60)
+    if days > 0:
+        return f"{days}d {hours:02d}:{minutes:02d}:{secs:02d}"
+    return f"{hours:02d}:{minutes:02d}:{secs:02d}"
+
+
+def normalize_color_for_matplotlib(color_tuple_0_255: tuple) -> tuple:
+    """(r,g,b) in 0..255 → 0..1 floats (reference: `helpers.py:98-108`)."""
+    return tuple(max(0.0, min(1.0, c / 255.0)) for c in color_tuple_0_255)

@@ -1,0 +1,64 @@
+"""Shared type definitions (reference: `alphatriangle/utils/types.py:8-56`).
+
+Two families live here:
+
+- **Parity types** — the per-sample dict/tuple forms the reference uses
+  (`StateType`, `Experience`, `PERBatchSample`), kept so the external
+  API reads the same.
+- **TPU-native batched types** — fixed-shape struct-of-arrays forms used
+  on device. XLA wants dense, static shapes, so the reference's
+  `dict[int, float]` policy mapping becomes a dense `(action_dim,)`
+  vector with zeros at illegal actions.
+"""
+
+from typing import TypedDict
+
+import numpy as np
+
+
+class StateType(TypedDict):
+    """NN input for one game state."""
+
+    grid: np.ndarray  # (C, H, W) float32; 1.0 occupied / 0.0 empty / -1.0 death
+    other_features: np.ndarray  # (OTHER_NN_INPUT_FEATURES_DIM,) float32
+
+
+ActionType = int
+
+# Sparse policy target {action: prob} — parity with the reference surface.
+PolicyTargetMapping = dict[ActionType, float]
+
+# (state, policy_target, n_step_return)
+Experience = tuple[StateType, PolicyTargetMapping, float]
+
+
+class PERBatchSample(TypedDict):
+    """One prioritized sample: batch plus tree bookkeeping."""
+
+    batch: list[Experience]
+    indices: np.ndarray  # (B,) int64 tree leaf indices
+    weights: np.ndarray  # (B,) float32 importance-sampling weights
+
+
+class DenseBatch(TypedDict):
+    """Fixed-shape training batch, ready for device transfer."""
+
+    grid: np.ndarray  # (B, C, H, W) float32
+    other_features: np.ndarray  # (B, F) float32
+    policy_target: np.ndarray  # (B, A) float32, rows sum to 1
+    value_target: np.ndarray  # (B,) float32 n-step returns
+    weights: np.ndarray  # (B,) float32 IS weights (ones if uniform)
+
+
+def dense_policy_from_mapping(mapping: PolicyTargetMapping, action_dim: int) -> np.ndarray:
+    """Scatter a sparse {action: prob} mapping into a dense vector."""
+    dense = np.zeros(action_dim, dtype=np.float32)
+    for a, p in mapping.items():
+        if 0 <= a < action_dim:
+            dense[a] = p
+    return dense
+
+
+def mapping_from_dense_policy(dense: np.ndarray, eps: float = 0.0) -> PolicyTargetMapping:
+    """Inverse of dense_policy_from_mapping; drops entries <= eps."""
+    return {int(a): float(p) for a, p in enumerate(dense) if p > eps}

@@ -1,0 +1,104 @@
+"""Config-model validation tests (reference analog: config validators)."""
+
+import pytest
+from pydantic import ValidationError
+
+from alphatriangle_tpu.config import (
+    AlphaTriangleMCTSConfig,
+    EnvConfig,
+    MeshConfig,
+    ModelConfig,
+    PersistenceConfig,
+    TrainConfig,
+    expected_other_features_dim,
+    print_config_info_and_validate,
+)
+
+
+def test_default_configs_validate_together():
+    cfgs = print_config_info_and_validate()
+    assert cfgs["env"].action_dim == 3 * 8 * 15
+    assert cfgs["model"].OTHER_NN_INPUT_FEATURES_DIM == 30
+
+
+def test_expected_other_features_dim_matches_reference_layout():
+    # 3 slots: 3*7 shape + 3 availability + 6 scalars = 30
+    assert expected_other_features_dim(EnvConfig()) == 30
+
+
+def test_env_config_rejects_bad_playable_range():
+    with pytest.raises(ValidationError):
+        EnvConfig(ROWS=2, COLS=3, PLAYABLE_RANGE_PER_ROW=[(0, 3)])
+    with pytest.raises(ValidationError):
+        EnvConfig(ROWS=1, COLS=3, PLAYABLE_RANGE_PER_ROW=[(2, 2)])
+    with pytest.raises(ValidationError):
+        EnvConfig(ROWS=1, COLS=3, PLAYABLE_RANGE_PER_ROW=[(0, 9)])
+
+
+def test_model_config_conv_consistency():
+    with pytest.raises(ValidationError):
+        ModelConfig(CONV_FILTERS=[8, 16], CONV_KERNEL_SIZES=[3], CONV_STRIDES=[1, 1])
+
+
+def test_model_config_transformer_divisibility():
+    with pytest.raises(ValidationError):
+        ModelConfig(TRANSFORMER_DIM=10, TRANSFORMER_HEADS=4, TRANSFORMER_LAYERS=1)
+
+
+def test_model_config_value_support():
+    with pytest.raises(ValidationError):
+        ModelConfig(VALUE_MIN=1.0, VALUE_MAX=-1.0)
+
+
+def test_train_config_derives_schedules():
+    cfg = TrainConfig(MAX_TRAINING_STEPS=1234)
+    assert cfg.LR_SCHEDULER_T_MAX == 1234
+    assert cfg.PER_BETA_ANNEAL_STEPS == 1234
+
+
+def test_train_config_buffer_invariants():
+    with pytest.raises(ValidationError):
+        TrainConfig(MIN_BUFFER_SIZE_TO_TRAIN=100, BUFFER_CAPACITY=10)
+    with pytest.raises(ValidationError):
+        TrainConfig(BATCH_SIZE=1000, BUFFER_CAPACITY=100, MIN_BUFFER_SIZE_TO_TRAIN=50)
+
+
+def test_train_config_beta_ordering():
+    with pytest.raises(ValidationError):
+        TrainConfig(PER_BETA_INITIAL=0.9, PER_BETA_FINAL=0.5)
+
+
+def test_mcts_config_defaults_match_reference():
+    cfg = AlphaTriangleMCTSConfig()
+    assert cfg.max_simulations == 64
+    assert cfg.max_depth == 8
+    assert cfg.cpuct == 1.5
+    assert cfg.mcts_batch_size == 32
+
+
+def test_mesh_config_builds_8_device_cpu_mesh():
+    import jax
+
+    mesh = MeshConfig(DP_SIZE=-1, MDL_SIZE=2).build_mesh(jax.devices("cpu"))
+    assert mesh.shape == {"dp": 4, "mdl": 2}
+
+
+def test_mesh_config_rejects_indivisible():
+    import jax
+
+    with pytest.raises(ValueError):
+        MeshConfig(MDL_SIZE=3).build_mesh(jax.devices("cpu"))
+
+
+def test_persistence_config_layout(tmp_path):
+    p = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="r1")
+    p.create_run_dirs()
+    assert (tmp_path / "AlphaTriangleTPU" / "runs" / "r1" / "checkpoints").is_dir()
+    assert (tmp_path / "AlphaTriangleTPU" / "runs" / "r1" / "tensorboard").is_dir()
+
+
+def test_validation_catches_feature_dim_mismatch():
+    env = EnvConfig()
+    model = ModelConfig(OTHER_NN_INPUT_FEATURES_DIM=13)
+    with pytest.raises(ValueError, match="OTHER_NN_INPUT_FEATURES_DIM"):
+        print_config_info_and_validate(env=env, model=model)

@@ -1,0 +1,115 @@
+"""SumTree + helper tests (reference analog: buffer/sumtree unit tests)."""
+
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.utils import (
+    SumTree,
+    dense_policy_from_mapping,
+    format_eta,
+    is_point_in_polygon,
+    mapping_from_dense_policy,
+    set_random_seeds,
+)
+
+
+class TestSumTree:
+    def test_add_and_total(self):
+        t = SumTree(8)
+        for i in range(5):
+            t.add(float(i + 1), f"item{i}")
+        assert t.total_priority == pytest.approx(15.0)
+        assert len(t) == 5
+
+    def test_ring_wraparound(self):
+        t = SumTree(4)
+        for i in range(6):
+            t.add(1.0, i)
+        assert len(t) == 4
+        assert t.total_priority == pytest.approx(4.0)
+        assert sorted(d for d in t.data) == [2, 3, 4, 5]
+
+    def test_update_propagates(self):
+        t = SumTree(4)
+        idx = t.add(1.0, "a")
+        t.add(2.0, "b")
+        t.update(idx, 5.0)
+        assert t.total_priority == pytest.approx(7.0)
+        assert t.max_priority == pytest.approx(5.0)
+
+    def test_get_leaf_selects_proportionally(self):
+        t = SumTree(4)
+        t.add(1.0, "low")
+        t.add(99.0, "high")
+        idx, prio, data = t.get_leaf(50.0)
+        assert data == "high"
+        assert prio == pytest.approx(99.0)
+        idx, prio, data = t.get_leaf(0.5)
+        assert data == "low"
+
+    def test_batched_matches_scalar(self):
+        rng = np.random.default_rng(1)
+        t = SumTree(33)  # non-power-of-two capacity
+        prios = rng.uniform(0.1, 5.0, size=33)
+        for i, p in enumerate(prios):
+            t.add(float(p), i)
+        values = rng.uniform(0, t.total_priority, size=64)
+        slots, got_prios = t.get_leaves(values)
+        for v, s, p in zip(values, slots, got_prios):
+            si, pi, _ = t.get_leaf(float(v))
+            assert si == s
+            assert pi == pytest.approx(p)
+
+    def test_sample_batch_distribution(self):
+        rng = np.random.default_rng(2)
+        t = SumTree(16)
+        t.add(90.0, "hot")
+        for i in range(15):
+            t.add(1.0, f"cold{i}")
+        slots, _ = t.sample_batch(512, rng)
+        hot_frac = float(np.mean(slots == 0))
+        assert hot_frac > 0.7  # 90/105 ≈ 0.857 expected
+
+    def test_update_batch_duplicate_indices_last_wins(self):
+        t = SumTree(4)
+        t.add(1.0, "a")
+        t.update_batch(np.array([0, 0]), np.array([3.0, 7.0]))
+        assert t.total_priority == pytest.approx(7.0)
+
+    def test_rejects_bad_priorities(self):
+        t = SumTree(4)
+        with pytest.raises(ValueError):
+            t.add(-1.0, "bad")
+        with pytest.raises(ValueError):
+            t.add(float("nan"), "bad")
+
+    def test_empty_sample_raises(self):
+        t = SumTree(4)
+        with pytest.raises(ValueError):
+            t.sample_batch(2, np.random.default_rng(0))
+
+
+def test_format_eta():
+    assert format_eta(None) == "N/A"
+    assert format_eta(-5) == "N/A"
+    assert format_eta(3661) == "01:01:01"
+    assert format_eta(90061) == "1d 01:01:01"
+
+
+def test_set_random_seeds_returns_key():
+    key = set_random_seeds(7)
+    assert key.shape == (2,) or key.dtype.name == "key<fry>" or key.size >= 1
+
+
+def test_dense_policy_roundtrip():
+    mapping = {0: 0.25, 3: 0.75}
+    dense = dense_policy_from_mapping(mapping, 5)
+    assert dense.sum() == pytest.approx(1.0)
+    assert mapping_from_dense_policy(dense) == {0: 0.25, 3: 0.75}
+
+
+def test_point_in_polygon():
+    square = [(0, 0), (2, 0), (2, 2), (0, 2)]
+    assert is_point_in_polygon((1, 1), square)
+    assert not is_point_in_polygon((3, 3), square)
+    assert is_point_in_polygon((0, 0), square)  # vertex counts as inside
