@@ -9,6 +9,8 @@ The TPU search evaluates one leaf per parallel game per simulation, so
 MXU batch is SELF_PLAY_BATCH_SIZE games wide.
 """
 
+import logging
+
 from pydantic import BaseModel, Field, model_validator
 
 
@@ -18,18 +20,24 @@ class AlphaTriangleMCTSConfig(BaseModel):
     max_simulations: int = Field(default=64, gt=0)
     max_depth: int = Field(default=8, gt=0)
     cpuct: float = Field(default=1.5, gt=0)
-    dirichlet_alpha: float = Field(default=0.3, gt=0)
+    # alpha=0 legitimately disables root noise (reference allows ge=0).
+    dirichlet_alpha: float = Field(default=0.3, ge=0)
     dirichlet_epsilon: float = Field(default=0.25, ge=0, le=1.0)
-    discount: float = Field(default=1.0, gt=0, le=1.0)
+    discount: float = Field(default=1.0, ge=0, le=1.0)
     # Parity knob (see module docstring); not a TPU batching control.
     mcts_batch_size: int = Field(default=32, gt=0)
 
     @model_validator(mode="after")
-    def _check(self) -> "AlphaTriangleMCTSConfig":
+    def _warn_depth(self) -> "AlphaTriangleMCTSConfig":
         if self.max_depth > self.max_simulations + 1:
-            # Deeper than the number of expansions is harmless but
-            # wastes fixed-size path buffers in the jitted search.
-            pass
+            # Deeper than the number of expansions wastes fixed-size
+            # path buffers in the jitted search.
+            logging.getLogger(__name__).warning(
+                "max_depth=%d exceeds max_simulations+1=%d; the extra depth "
+                "can never be reached and only widens jitted path buffers.",
+                self.max_depth,
+                self.max_simulations + 1,
+            )
         return self
 
 

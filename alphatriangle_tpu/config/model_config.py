@@ -52,7 +52,6 @@ class ModelConfig(BaseModel):
     # Norm layer. "batch" matches the reference (`model_config.py:54`) but
     # carries running statistics; "group" is stateless and shards cleanly.
     NORM_TYPE: Literal["group", "layer", "batch", "none"] = Field(default="group")
-    USE_BATCH_NORM: bool = Field(default=True)  # parity alias; see NORM_TYPE
 
     OTHER_NN_INPUT_FEATURES_DIM: int = Field(default=30, gt=0)
 
@@ -61,6 +60,23 @@ class ModelConfig(BaseModel):
     PARAM_DTYPE: Literal["float32"] = Field(default="float32")
     # jax.checkpoint the residual + transformer blocks to trade FLOPs for HBM.
     REMAT: bool = Field(default=False)
+
+    @property
+    def USE_BATCH_NORM(self) -> bool:
+        """Parity alias for the reference knob, derived from NORM_TYPE so
+        the two can never disagree (`alphatriangle/config/model_config.py:54`)."""
+        return self.NORM_TYPE == "batch"
+
+    @model_validator(mode="before")
+    @classmethod
+    def _map_use_batch_norm(cls, data):
+        # Accept the reference's USE_BATCH_NORM kwarg by mapping it onto
+        # NORM_TYPE (explicit NORM_TYPE wins if both are given).
+        if isinstance(data, dict) and "USE_BATCH_NORM" in data:
+            use_bn = data.pop("USE_BATCH_NORM")
+            if "NORM_TYPE" not in data:
+                data["NORM_TYPE"] = "batch" if use_bn else "group"
+        return data
 
     @model_validator(mode="after")
     def _check_conv_consistency(self) -> "ModelConfig":

@@ -20,6 +20,10 @@ def is_point_in_polygon(
         # On-vertex / on-edge quick accept.
         if (xi, yi) == (x, y):
             return True
+        # Collinear-on-horizontal-edge: the ray-crossing test below skips
+        # edges with yi == yj, so points lying on them need this check.
+        if yi == yj == y and min(xi, xj) <= x <= max(xi, xj):
+            return True
         if (yi > y) != (yj > y):
             x_cross = (xj - xi) * (y - yi) / (yj - yi) + xi
             if abs(x - x_cross) < 1e-12:

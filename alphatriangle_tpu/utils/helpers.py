@@ -10,14 +10,15 @@ logger = logging.getLogger(__name__)
 
 
 def get_device(preference: str = "auto") -> jax.Device:
-    """Pick the compute device: TPU > GPU > CPU (reference picked CUDA>MPS>CPU)."""
+    """Pick the compute device: TPU > GPU > CPU (reference picked CUDA>MPS>CPU).
+
+    An explicit preference that cannot be satisfied raises (RuntimeError
+    from `jax.devices(platform)`) — it never silently falls back to CPU.
+    """
     if preference not in ("auto", "tpu", "gpu", "cpu"):
         raise ValueError(f"unknown device preference: {preference}")
     if preference != "auto":
-        devs = jax.devices(preference) if preference != "tpu" else [
-            d for d in jax.devices() if d.platform != "cpu"
-        ] or jax.devices()
-        return devs[0]
+        return jax.devices(preference)[0]
     return jax.devices()[0]
 
 

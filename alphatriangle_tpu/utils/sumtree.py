@@ -62,6 +62,8 @@ class SumTree:
         """
         idxs = np.asarray(idxs, dtype=np.int64)
         priorities = np.asarray(priorities, dtype=np.float64)
+        if len(idxs) == 0:
+            return
         if np.any(priorities < 0) or not np.all(np.isfinite(priorities)):
             raise ValueError("priorities must be finite and non-negative")
         # Last-write-wins dedupe.
@@ -103,6 +105,8 @@ class SumTree:
     def get_leaves(self, values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized descent: (K,) prefix values → (slots, priorities)."""
         values = np.asarray(values, dtype=np.float64).copy()
+        if len(values) == 0:
+            return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float64)
         nodes = np.ones(len(values), dtype=np.int64)
         while nodes[0] < self._cap2:
             left = 2 * nodes
