@@ -71,11 +71,14 @@ class ModelConfig(BaseModel):
     @classmethod
     def _map_use_batch_norm(cls, data):
         # Accept the reference's USE_BATCH_NORM kwarg by mapping it onto
-        # NORM_TYPE (explicit NORM_TYPE wins if both are given).
+        # NORM_TYPE (explicit NORM_TYPE wins if both are given). False
+        # means "no normalization" in the reference architecture, not an
+        # alternative norm.
         if isinstance(data, dict) and "USE_BATCH_NORM" in data:
+            data = {**data}
             use_bn = data.pop("USE_BATCH_NORM")
             if "NORM_TYPE" not in data:
-                data["NORM_TYPE"] = "batch" if use_bn else "group"
+                data["NORM_TYPE"] = "batch" if use_bn else "none"
         return data
 
     @model_validator(mode="after")

@@ -1,0 +1,34 @@
+"""TPU-native triangle puzzle environment.
+
+Functional equivalent of the reference's C++ `trianglengin` package
+(surface reconstructed in SURVEY.md §2b from call sites such as
+`alphatriangle/rl/self_play/worker.py:190-377` and
+`alphatriangle/features/extractor.py:25-118`) — redesigned as a
+struct-of-arrays, jit/vmap-able JAX environment so thousands of games
+step in lockstep on the accelerator instead of one C++ object per
+Python process.
+
+Public surface:
+- `ShapeBank`, `build_shape_bank` — the static library of placeable shapes.
+- `EnvGeometry`, `build_geometry` — death mask, parity mask, line masks.
+- `TriangleEnv`, `EnvState` — the batched pure-functional engine.
+- `GameState`, `Shape` — host-side single-game parity wrapper matching
+  the reference `trianglengin.GameState` API.
+"""
+
+from .engine import EnvState, TriangleEnv
+from .game_state import GameState, Shape
+from .geometry import EnvGeometry, build_geometry
+from .shapes import ShapeBank, build_shape_bank, enumerate_shapes
+
+__all__ = [
+    "EnvGeometry",
+    "EnvState",
+    "GameState",
+    "Shape",
+    "ShapeBank",
+    "TriangleEnv",
+    "build_geometry",
+    "build_shape_bank",
+    "enumerate_shapes",
+]

@@ -1,0 +1,225 @@
+"""Batched pure-functional triangle puzzle engine.
+
+TPU-native replacement for the reference's per-process C++
+`trianglengin.GameState` (surface at
+`alphatriangle/rl/self_play/worker.py:190-377`): game state is a
+struct-of-arrays pytree, and `reset` / `step` / `valid_action_mask` are
+pure jittable functions, vmappable across a whole batch of games so
+self-play steps thousands of boards per device dispatch.
+
+Semantics (behavior contract, pinned by tests/test_env.py):
+- Action encoding: `slot * ROWS * COLS + r * COLS + c`
+  (reference: `alphatriangle/nn/model.py:122-125`).
+- A placement is valid iff the slot holds a shape and every triangle of
+  the shape lands in-bounds on a playable, unoccupied cell of matching
+  orientation (up/down parity).
+- After placement every full line (geometry.build_line_masks) clears
+  simultaneously; reward = placed * REWARD_PER_PLACED_TRIANGLE +
+  cleared * REWARD_PER_CLEARED_TRIANGLE, both also added to the score.
+- The consumed slot empties; when all slots are empty the hand refills
+  with NUM_SHAPE_SLOTS uniform draws from the shape bank.
+- The game ends (PENALTY_GAME_OVER added to reward, not score) when no
+  remaining shape has a valid placement. Stepping an invalid action
+  ends the game the same way. Stepping a finished game is a no-op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config.env_config import EnvConfig
+from .geometry import EnvGeometry, build_geometry
+from .shapes import ShapeBank, build_shape_bank
+
+
+@struct.dataclass
+class EnvState:
+    """One game's state (add a leading batch dim via vmap)."""
+
+    occupied: jax.Array  # (R, C) bool
+    color: jax.Array  # (R, C) int8; -1 where empty
+    shape_idx: jax.Array  # (SLOTS,) int32 into the bank; -1 = consumed
+    shape_color: jax.Array  # (SLOTS,) int8
+    score: jax.Array  # () float32
+    step_count: jax.Array  # () int32
+    done: jax.Array  # () bool
+    last_cleared: jax.Array  # () int32 triangles cleared by the last step
+    key: jax.Array  # PRNG key driving shape refills
+
+
+class TriangleEnv:
+    """Static env: config + precomputed geometry + jitted transition fns.
+
+    Instances are cheap, immutable, and safe to share across threads;
+    all mutable state lives in `EnvState` pytrees owned by the caller.
+    """
+
+    def __init__(self, cfg: EnvConfig):
+        self.cfg = cfg
+        self.bank: ShapeBank = build_shape_bank(cfg)
+        self.geometry: EnvGeometry = build_geometry(cfg)
+        self.rows, self.cols = cfg.ROWS, cfg.COLS
+        self.num_slots = cfg.NUM_SHAPE_SLOTS
+        self.action_dim = cfg.action_dim
+
+        # Device-side static geometry (XLA embeds these as constants).
+        self._tri_r = jnp.asarray(self.bank.tri_r)
+        self._tri_c = jnp.asarray(self.bank.tri_c)
+        self._tri_up = jnp.asarray(self.bank.tri_up)
+        self._tri_valid = jnp.asarray(self.bank.tri_valid)
+        self._n_tris = jnp.asarray(self.bank.n_tris)
+        self._death = jnp.asarray(self.geometry.death)
+        self._line_masks = jnp.asarray(self.geometry.line_masks)
+        rr, cc = jnp.meshgrid(
+            jnp.arange(self.rows), jnp.arange(self.cols), indexing="ij"
+        )
+        self._rr, self._cc = rr, cc
+
+        # Jitted batched entry points (leading batch dim).
+        self.reset_batch = jax.jit(jax.vmap(self.reset))
+        self.step_batch = jax.jit(jax.vmap(self.step))
+        self.valid_mask_batch = jax.jit(jax.vmap(self.valid_action_mask))
+        self.reset_where_done_jit = jax.jit(self.reset_where_done)
+
+    # --- transition functions (single game; vmap for batches) -------------
+
+    def _slot_placements(self, occupied: jax.Array, shape_idx: jax.Array) -> jax.Array:
+        """(R, C) bool of valid origins for one slot's shape.
+
+        Returns all-False for an empty slot (shape_idx < 0).
+        """
+        sidx = jnp.maximum(shape_idx, 0)
+        tr = self._rr[:, :, None] + self._tri_r[sidx][None, None, :]  # (R, C, T)
+        tc = self._cc[:, :, None] + self._tri_c[sidx][None, None, :]
+        inb = (tr >= 0) & (tr < self.rows) & (tc >= 0) & (tc < self.cols)
+        trc = jnp.clip(tr, 0, self.rows - 1)
+        tcc = jnp.clip(tc, 0, self.cols - 1)
+        free = ~(occupied[trc, tcc] | self._death[trc, tcc])
+        parity_ok = ((tr + tc) % 2 == 0) == self._tri_up[sidx][None, None, :]
+        ok = (inb & free & parity_ok) | ~self._tri_valid[sidx][None, None, :]
+        return ok.all(axis=-1) & (shape_idx >= 0)
+
+    def valid_action_mask(self, state: EnvState) -> jax.Array:
+        """(action_dim,) bool; all-False when the game is over."""
+        per_slot = jax.vmap(self._slot_placements, in_axes=(None, 0))(
+            state.occupied, state.shape_idx
+        )  # (SLOTS, R, C)
+        return per_slot.reshape(-1) & ~state.done
+
+    def _any_placement(self, occupied: jax.Array, shape_idx: jax.Array) -> jax.Array:
+        per_slot = jax.vmap(self._slot_placements, in_axes=(None, 0))(
+            occupied, shape_idx
+        )
+        return per_slot.any()
+
+    def _draw_hand(self, key: jax.Array) -> tuple[jax.Array, jax.Array]:
+        k1, k2 = jax.random.split(key)
+        idx = jax.random.randint(k1, (self.num_slots,), 0, self.bank.n_shapes)
+        col = jax.random.randint(k2, (self.num_slots,), 0, self.cfg.NUM_COLORS)
+        return idx.astype(jnp.int32), col.astype(jnp.int8)
+
+    def reset(self, key: jax.Array) -> EnvState:
+        key, sub = jax.random.split(key)
+        shape_idx, shape_color = self._draw_hand(sub)
+        state = EnvState(
+            occupied=jnp.zeros((self.rows, self.cols), dtype=bool),
+            color=jnp.full((self.rows, self.cols), -1, dtype=jnp.int8),
+            shape_idx=shape_idx,
+            shape_color=shape_color,
+            score=jnp.float32(0.0),
+            step_count=jnp.int32(0),
+            done=jnp.bool_(False),
+            last_cleared=jnp.int32(0),
+            key=key,
+        )
+        # A fresh board can still be unplayable on exotic configs.
+        done = ~self._any_placement(state.occupied, state.shape_idx)
+        return state.replace(done=done)
+
+    def step(self, state: EnvState, action: jax.Array) -> tuple[EnvState, jax.Array, jax.Array]:
+        """Apply one action. Returns (next_state, reward, done)."""
+        cfg = self.cfg
+        cells = self.rows * self.cols
+        slot = action // cells
+        r = (action % cells) // self.cols
+        c = action % self.cols
+
+        sidx = jnp.maximum(state.shape_idx[slot], 0)
+        placeable = self._slot_placements(state.occupied, state.shape_idx[slot])
+        valid = placeable[r, c] & ~state.done
+
+        # --- place ---
+        # Padding triangles get an out-of-bounds row so drop-mode scatters
+        # ignore them (clipping could alias a real cell and corrupt it).
+        tri_on = self._tri_valid[sidx]
+        tr = jnp.where(tri_on, r + self._tri_r[sidx], self.rows)
+        tc = c + self._tri_c[sidx]
+        occ_placed = state.occupied.at[tr, tc].set(True, mode="drop")
+        color_placed = state.color.at[tr, tc].set(
+            state.shape_color[slot], mode="drop"
+        )
+        n_placed = self._n_tris[sidx]
+
+        # --- clear full lines ---
+        full = (occ_placed | ~self._line_masks).all(axis=(1, 2))  # (L,)
+        cleared_cells = (self._line_masks & full[:, None, None]).any(axis=0)
+        n_cleared = cleared_cells.sum(dtype=jnp.int32)
+        occ_next = occ_placed & ~cleared_cells
+        color_next = jnp.where(cleared_cells, jnp.int8(-1), color_placed)
+
+        # --- consume slot; refill when the hand is empty ---
+        hand = state.shape_idx.at[slot].set(-1)
+        hand_colors = state.shape_color
+        all_empty = (hand < 0).all()
+        key, sub = jax.random.split(state.key)
+        new_idx, new_col = self._draw_hand(sub)
+        hand = jnp.where(all_empty, new_idx, hand)
+        hand_colors = jnp.where(all_empty, new_col, hand_colors)
+
+        # --- termination: no remaining shape fits ---
+        stuck = ~self._any_placement(occ_next, hand)
+
+        gain = (
+            n_placed.astype(jnp.float32) * cfg.REWARD_PER_PLACED_TRIANGLE
+            + n_cleared.astype(jnp.float32) * cfg.REWARD_PER_CLEARED_TRIANGLE
+        )
+        reward_valid = gain + jnp.where(stuck, cfg.PENALTY_GAME_OVER, 0.0)
+
+        next_valid = EnvState(
+            occupied=occ_next,
+            color=color_next,
+            shape_idx=hand,
+            shape_color=hand_colors,
+            score=state.score + gain,
+            step_count=state.step_count + 1,
+            done=stuck,
+            last_cleared=n_cleared,
+            key=key,
+        )
+        # Invalid action on a live game: forfeit (state frozen, game over).
+        next_invalid = state.replace(done=jnp.bool_(True), last_cleared=jnp.int32(0))
+        reward_invalid = jnp.where(
+            state.done, 0.0, jnp.float32(cfg.PENALTY_GAME_OVER)
+        )
+
+        next_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(valid, a, b), next_valid, next_invalid
+        )
+        reward = jnp.where(valid, reward_valid, reward_invalid)
+        return next_state, reward.astype(jnp.float32), next_state.done
+
+    def reset_where_done(self, state: EnvState, key: jax.Array) -> EnvState:
+        """Batched helper: replace finished games with fresh ones.
+
+        `state` must be batched (leading dim B); `key` is a single key.
+        """
+        batch = state.done.shape[0]
+        fresh = jax.vmap(self.reset)(jax.random.split(key, batch))
+        return jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                state.done.reshape((batch,) + (1,) * (old.ndim - 1)), new, old
+            ),
+            fresh,
+            state,
+        )

@@ -1,0 +1,138 @@
+"""Host-side single-game wrapper with the reference's GameState API.
+
+Parity surface for the C++ `trianglengin.GameState` as observed at its
+call sites (`alphatriangle/rl/self_play/worker.py:190-377`,
+`alphatriangle/features/extractor.py:25-118`,
+`tests/nn/test_network.py:151`). The wrapper delegates every transition
+to the jitted single-game `TriangleEnv` functions, so host play and
+device self-play share one rules implementation by construction.
+
+Not a hot path: on-device batched self-play never touches this class.
+It exists for interactive play, debugging, tests, and API familiarity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.env_config import EnvConfig
+from .engine import EnvState, TriangleEnv
+
+# One compiled engine per EnvConfig (jit caches live on the env instance).
+_ENV_CACHE: dict[str, TriangleEnv] = {}
+
+
+def get_env(cfg: EnvConfig) -> TriangleEnv:
+    key = cfg.model_dump_json()
+    env = _ENV_CACHE.get(key)
+    if env is None:
+        env = _ENV_CACHE[key] = TriangleEnv(cfg)
+    return env
+
+
+class Shape:
+    """A placeable shape (reference `trianglengin.Shape` surface)."""
+
+    def __init__(self, triangles: list[tuple[int, int, bool]], color_id: int = 0):
+        self.triangles = triangles  # list of (r, c, is_up)
+        self.color_id = color_id
+
+    def bbox(self) -> tuple[int, int, int, int]:
+        """(min_r, min_c, max_r, max_c) over the shape's triangles."""
+        rs = [t[0] for t in self.triangles]
+        cs = [t[1] for t in self.triangles]
+        return min(rs), min(cs), max(rs), max(cs)
+
+    def __len__(self) -> int:
+        return len(self.triangles)
+
+    def __repr__(self) -> str:
+        return f"Shape({len(self.triangles)} tris, color={self.color_id})"
+
+
+class GameState:
+    """One interactive game over the jitted functional engine."""
+
+    def __init__(
+        self,
+        env_config: EnvConfig | None = None,
+        initial_seed: int = 0,
+        _state: EnvState | None = None,
+    ):
+        self.env_config = env_config or EnvConfig()
+        self._env = get_env(self.env_config)
+        if _state is not None:
+            self._state = _state
+        else:
+            self._state = self._env.reset(jax.random.PRNGKey(initial_seed))
+        self._last_reward = 0.0
+
+    # --- queries ----------------------------------------------------------
+
+    def is_over(self) -> bool:
+        return bool(self._state.done)
+
+    def get_game_over_reason(self) -> str | None:
+        if not self.is_over():
+            return None
+        return "no valid placement for any remaining shape"
+
+    def valid_actions(self) -> list[int]:
+        mask = np.asarray(self._env.valid_action_mask(self._state))
+        return [int(a) for a in np.flatnonzero(mask)]
+
+    def valid_action_mask(self) -> np.ndarray:
+        """(action_dim,) bool — dense form (TPU-native extension)."""
+        return np.asarray(self._env.valid_action_mask(self._state))
+
+    def game_score(self) -> float:
+        return float(self._state.score)
+
+    @property
+    def current_step(self) -> int:
+        return int(self._state.step_count)
+
+    def get_last_cleared_triangles(self) -> int:
+        return int(self._state.last_cleared)
+
+    def get_grid_data_np(self) -> dict[str, np.ndarray]:
+        """Dense grid views: occupied / death / color_id (copies)."""
+        return {
+            "occupied": np.asarray(self._state.occupied),
+            "death": self._env.geometry.death.copy(),
+            "color_id": np.asarray(self._state.color),
+        }
+
+    def get_shapes(self) -> list[Shape | None]:
+        """Current hand; None for consumed slots."""
+        out: list[Shape | None] = []
+        bank = self._env.bank
+        for k in range(self.env_config.NUM_SHAPE_SLOTS):
+            sidx = int(self._state.shape_idx[k])
+            if sidx < 0:
+                out.append(None)
+                continue
+            tris = [
+                (int(r), int(c), (int(r) + int(c)) % 2 == 0)
+                for r, c in bank.shapes[sidx]
+            ]
+            out.append(Shape(tris, color_id=int(self._state.shape_color[k])))
+        return out
+
+    # --- transitions ------------------------------------------------------
+
+    def step(self, action: int) -> tuple[float, bool]:
+        """Apply `action`; returns (reward, done)."""
+        state, reward, done = self._env.step(self._state, jnp.int32(action))
+        self._state = state
+        self._last_reward = float(reward)
+        return float(reward), bool(done)
+
+    def copy(self) -> "GameState":
+        return GameState(self.env_config, _state=self._state)
+
+    def __repr__(self) -> str:
+        return (
+            f"GameState(step={self.current_step}, score={self.game_score():.1f}, "
+            f"over={self.is_over()})"
+        )

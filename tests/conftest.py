@@ -8,13 +8,23 @@ sharding paths are exercised without TPU hardware.
 
 import os
 
-# Must happen before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax import anywhere in the test process. Force CPU
+# even when the ambient environment points at a real accelerator (e.g.
+# JAX_PLATFORMS=axon): tests exercise sharding on virtual CPU devices and
+# must not contend for the TPU with a training/bench process.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# Accelerator site hooks (e.g. the axon TPU plugin's sitecustomize) can
+# force jax_platforms at interpreter startup, overriding the env var;
+# re-assert CPU at the config layer before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

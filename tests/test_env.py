@@ -1,0 +1,336 @@
+"""Golden behavior contracts for the TPU-native triangle engine.
+
+These pin the reconstructed rules (SURVEY.md §2b trianglengin row) so
+every later layer builds against stable semantics: shape enumeration,
+line geometry, placement legality, clearing, refill, termination, and
+the GameState parity surface.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import EnvConfig
+from alphatriangle_tpu.env import (
+    GameState,
+    TriangleEnv,
+    build_geometry,
+    build_shape_bank,
+    enumerate_shapes,
+)
+from alphatriangle_tpu.env.shapes import _is_up, _neighbors
+
+
+# --- shape bank ------------------------------------------------------------
+
+
+def test_enumerate_shape_counts():
+    # Fixed polyiamonds (translation-only dedupe): 2, 3, 6, 14, 36.
+    sizes = [len([s for s in enumerate_shapes(n, n)]) for n in range(1, 6)]
+    assert sizes == [2, 3, 6, 14, 36]
+    assert len(enumerate_shapes(1, 5)) == 61
+
+
+def test_shapes_connected_and_canonical():
+    for shape in enumerate_shapes(1, 4):
+        cells = set(shape)
+        # Connectivity via flood fill.
+        seen = {shape[0]}
+        frontier = [shape[0]]
+        while frontier:
+            cur = frontier.pop()
+            for nb in _neighbors(*cur):
+                if nb in cells and nb not in seen:
+                    seen.add(nb)
+                    frontier.append(nb)
+        assert seen == cells
+        # Canonical: min row 0, min col in {0, 1}.
+        assert min(r for r, _ in shape) == 0
+        assert min(c for _, c in shape) in (0, 1)
+
+
+def test_bank_arrays_consistent(tiny_env_config):
+    bank = build_shape_bank(tiny_env_config)
+    assert bank.n_shapes == 2 + 3 + 6  # sizes 1..3
+    assert bank.max_tris == tiny_env_config.MAX_SHAPE_TRIANGLES
+    for i in range(bank.n_shapes):
+        n = int(bank.n_tris[i])
+        assert bank.tri_valid[i, :n].all() and not bank.tri_valid[i, n:].any()
+        for j in range(n):
+            r, c = int(bank.tri_r[i, j]), int(bank.tri_c[i, j])
+            assert bool(bank.tri_up[i, j]) == _is_up(r, c)
+
+
+# --- geometry --------------------------------------------------------------
+
+
+def test_death_mask_from_playable_ranges():
+    cfg = EnvConfig()
+    geo = build_geometry(cfg)
+    assert geo.death.shape == (cfg.ROWS, cfg.COLS)
+    for r, (lo, hi) in enumerate(cfg.PLAYABLE_RANGE_PER_ROW):
+        assert not geo.death[r, lo:hi].any()
+        assert geo.death[r, :lo].all() and geo.death[r, hi:].all()
+
+
+def test_line_masks_properties(tiny_env_config):
+    geo = build_geometry(tiny_env_config)
+    assert geo.n_lines > 0
+    for mask in geo.line_masks:
+        n = int(mask.sum())
+        assert n >= tiny_env_config.LINE_MIN_LENGTH
+        assert not (mask & geo.death).any()  # lines live on playable cells
+    # On the 3x4 all-playable board the 3 horizontal lines cover all cells.
+    horizontal = [m for m in geo.line_masks if len(set(np.nonzero(m)[0])) == 1]
+    assert len(horizontal) == 3
+
+
+def test_line_masks_default_board():
+    geo = build_geometry(EnvConfig())
+    # Default 8x15 board: 8 horizontal lines plus diagonals both ways.
+    assert geo.n_lines >= 8
+
+
+# --- engine: reset / placement / clearing ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_env(tiny_env_config):
+    return TriangleEnv(tiny_env_config)
+
+
+def _hand(env, state, shape_ids):
+    """Test helper: inject specific shapes into the hand."""
+    return state.replace(
+        shape_idx=jnp.asarray(shape_ids, dtype=jnp.int32),
+        shape_color=jnp.zeros(env.num_slots, dtype=jnp.int8),
+    )
+
+
+def test_reset_deterministic_and_empty(tiny_env):
+    s1 = tiny_env.reset(jax.random.PRNGKey(7))
+    s2 = tiny_env.reset(jax.random.PRNGKey(7))
+    assert not np.asarray(s1.occupied).any()
+    assert float(s1.score) == 0.0 and int(s1.step_count) == 0
+    assert not bool(s1.done)
+    assert (np.asarray(s1.shape_idx) >= 0).all()
+    np.testing.assert_array_equal(np.asarray(s1.shape_idx), np.asarray(s2.shape_idx))
+
+
+def test_valid_mask_matches_manual_check(tiny_env):
+    # Canonical anchors always have even parity: the up single occupies
+    # its origin cell, the down single occupies (r, c+1).
+    state = tiny_env.reset(jax.random.PRNGKey(0))
+    for sid, dc in ((0, 0), (1, 1)):
+        st = _hand(tiny_env, state, [sid])
+        mask = np.asarray(tiny_env.valid_action_mask(st))
+        assert mask.shape == (tiny_env.action_dim,)
+        for a in range(tiny_env.action_dim):
+            r = (a % 12) // 4
+            c = a % 4
+            expected = (r + c) % 2 == 0 and c + dc < 4
+            assert mask[a] == expected, (sid, r, c)
+
+
+def test_place_and_score(tiny_env):
+    state = tiny_env.reset(jax.random.PRNGKey(0))
+    state = _hand(tiny_env, state, [0])  # up single at (0,0)
+    state, reward, done = tiny_env.step(state, jnp.int32(0))
+    assert float(reward) == tiny_env.cfg.REWARD_PER_PLACED_TRIANGLE
+    assert float(state.score) == float(reward)
+    occ = np.asarray(state.occupied)
+    assert occ[0, 0] and occ.sum() == 1
+    assert int(state.step_count) == 1 and not bool(done)
+    assert int(state.last_cleared) == 0
+
+
+def test_fill_row_clears_line(tiny_env):
+    # Place singles across row 0; the 4-cell horizontal line clears.
+    state = tiny_env.reset(jax.random.PRNGKey(0))
+    total = 0.0
+    for c in range(4):
+        # Cover cell (0, c): even cells via the up single anchored there,
+        # odd cells via the down single anchored one column left.
+        sid, action = (0, c) if c % 2 == 0 else (1, c - 1)
+        state = _hand(tiny_env, state, [sid])
+        state, reward, done = tiny_env.step(state, jnp.int32(action))
+        total += float(reward)
+    assert int(state.last_cleared) == 4
+    # Last reward: 1 placed + 4 cleared * 2.0.
+    assert float(reward) == pytest.approx(1.0 + 4 * 2.0)
+    assert not np.asarray(state.occupied)[0].any()  # row cleared
+    assert float(state.score) == pytest.approx(total)
+
+
+def test_full_board_clears_everything(tiny_env):
+    # Occupy all but (0,0); placing the last up triangle fills every
+    # horizontal line simultaneously and the whole board clears.
+    state = tiny_env.reset(jax.random.PRNGKey(0))
+    occ = np.ones((3, 4), dtype=bool)
+    occ[0, 0] = False
+    state = state.replace(occupied=jnp.asarray(occ))
+    state = _hand(tiny_env, state, [0])
+    state, reward, done = tiny_env.step(state, jnp.int32(0))
+    assert int(state.last_cleared) == 12
+    assert not np.asarray(state.occupied).any()
+    assert float(reward) == pytest.approx(1.0 + 12 * 2.0)
+    assert not bool(done)
+
+
+def test_invalid_action_forfeits(tiny_env):
+    state = tiny_env.reset(jax.random.PRNGKey(0))
+    state = _hand(tiny_env, state, [0])
+    before = np.asarray(state.occupied).copy()
+    # Action 1 has odd parity: invalid for the up single triangle.
+    state, reward, done = tiny_env.step(state, jnp.int32(1))
+    assert bool(done)
+    assert float(reward) == tiny_env.cfg.PENALTY_GAME_OVER
+    np.testing.assert_array_equal(np.asarray(state.occupied), before)
+    # Stepping a finished game is a no-op with zero reward.
+    state2, reward2, done2 = tiny_env.step(state, jnp.int32(0))
+    assert bool(done2) and float(reward2) == 0.0
+
+
+def test_stuck_game_over_with_penalty():
+    # No clearable lines (LINE_MIN_LENGTH > board) on a 2x2 board: filling
+    # the last cell leaves a full board, and the fresh hand cannot fit.
+    cfg = EnvConfig(
+        ROWS=2,
+        COLS=2,
+        PLAYABLE_RANGE_PER_ROW=[(0, 2), (0, 2)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=1,
+        LINE_MIN_LENGTH=99,
+    )
+    env = TriangleEnv(cfg)
+    state = env.reset(jax.random.PRNGKey(0))
+    occ = np.ones((2, 2), dtype=bool)
+    occ[0, 0] = False
+    state = state.replace(
+        occupied=jnp.asarray(occ),
+        shape_idx=jnp.asarray([0], dtype=jnp.int32),
+    )
+    state, reward, done = env.step(state, jnp.int32(0))
+    assert bool(done)
+    assert float(reward) == pytest.approx(1.0 + cfg.PENALTY_GAME_OVER)
+    # Penalty is not part of the score.
+    assert float(state.score) == pytest.approx(1.0)
+
+
+def test_hand_refills_only_when_empty():
+    cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4)] * 3,
+        NUM_SHAPE_SLOTS=2,
+        MAX_SHAPE_TRIANGLES=1,
+    )
+    env = TriangleEnv(cfg)
+    state = env.reset(jax.random.PRNGKey(3))
+    state = state.replace(shape_idx=jnp.asarray([0, 1], dtype=jnp.int32))
+    # Consume slot 0 (up single at (0,0)).
+    state, _, _ = env.step(state, jnp.int32(0))
+    hand = np.asarray(state.shape_idx)
+    assert hand[0] == -1 and hand[1] == 1  # no refill yet
+    # Consume slot 1: down single anchored at (0,0) occupies cell (0,1).
+    state, _, _ = env.step(state, jnp.int32(12 + 0))
+    hand = np.asarray(state.shape_idx)
+    assert (hand >= 0).all()  # refilled
+
+
+# --- batched episodes under jit --------------------------------------------
+
+
+def test_batched_random_episodes(tiny_env):
+    batch = 8
+    keys = jax.random.split(jax.random.PRNGKey(42), batch)
+    state = tiny_env.reset_batch(keys)
+    rng = np.random.default_rng(0)
+    total_steps = 0
+    for _ in range(200):
+        mask = np.asarray(tiny_env.valid_mask_batch(state))
+        if not mask.any():
+            break
+        # Random valid action per live game (0 for finished ones).
+        actions = np.zeros(batch, dtype=np.int32)
+        for b in range(batch):
+            valid = np.flatnonzero(mask[b])
+            if len(valid):
+                actions[b] = rng.choice(valid)
+        state, rewards, dones = tiny_env.step_batch(state, jnp.asarray(actions))
+        assert np.isfinite(np.asarray(rewards)).all()
+        total_steps += 1
+        if np.asarray(dones).all():
+            break
+    assert np.asarray(state.done).all(), "random play should end within 200 moves"
+    assert total_steps > 2
+
+
+def test_reset_where_done(tiny_env):
+    batch = 4
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+    state = tiny_env.reset_batch(keys)
+    done = np.zeros(batch, dtype=bool)
+    done[1] = True
+    state = state.replace(
+        done=jnp.asarray(done),
+        score=jnp.full((batch,), 5.0, dtype=jnp.float32),
+    )
+    out = tiny_env.reset_where_done_jit(state, jax.random.PRNGKey(9))
+    scores = np.asarray(out.score)
+    assert scores[1] == 0.0  # replaced
+    assert (scores[[0, 2, 3]] == 5.0).all()  # untouched
+    assert not np.asarray(out.done).any()
+
+
+# --- GameState parity wrapper ----------------------------------------------
+
+
+def test_game_state_surface(tiny_env_config):
+    gs = GameState(tiny_env_config, initial_seed=11)
+    assert not gs.is_over()
+    assert gs.get_game_over_reason() is None
+    assert gs.game_score() == 0.0
+    assert gs.current_step == 0
+    grid = gs.get_grid_data_np()
+    assert set(grid) == {"occupied", "death", "color_id"}
+    assert grid["occupied"].shape == (3, 4)
+    assert grid["occupied"].dtype == bool
+    shapes = gs.get_shapes()
+    assert len(shapes) == tiny_env_config.NUM_SHAPE_SLOTS
+    for sh in shapes:
+        assert sh is not None
+        assert 1 <= len(sh.triangles) <= tiny_env_config.MAX_SHAPE_TRIANGLES
+        mn_r, mn_c, mx_r, mx_c = sh.bbox()
+        assert mn_r <= mx_r and mn_c <= mx_c
+        for r, c, up in sh.triangles:
+            assert up == ((r + c) % 2 == 0)
+
+
+def test_game_state_full_episode(tiny_env_config):
+    rng = np.random.default_rng(5)
+    gs = GameState(tiny_env_config, initial_seed=2)
+    rewards = []
+    for _ in range(100):
+        if gs.is_over():
+            break
+        acts = gs.valid_actions()
+        assert acts, "live game must expose valid actions"
+        reward, done = gs.step(int(rng.choice(acts)))
+        rewards.append(reward)
+    assert gs.is_over()
+    assert gs.get_game_over_reason() is not None
+    # Score equals the gains; the final reward carries the game-over penalty.
+    expected = sum(rewards) - tiny_env_config.PENALTY_GAME_OVER
+    assert gs.game_score() == pytest.approx(expected)
+    assert gs.current_step == len(rewards)
+
+
+def test_game_state_copy_independent(tiny_env_config):
+    gs = GameState(tiny_env_config, initial_seed=3)
+    clone = gs.copy()
+    act = gs.valid_actions()[0]
+    gs.step(act)
+    assert clone.current_step == 0
+    assert gs.current_step == 1
