@@ -25,7 +25,6 @@ Semantics (behavior contract, pinned by tests/test_env.py):
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import struct
 
 from ..config.env_config import EnvConfig
@@ -81,6 +80,10 @@ class TriangleEnv:
         self.step_batch = jax.jit(jax.vmap(self.step))
         self.valid_mask_batch = jax.jit(jax.vmap(self.valid_action_mask))
         self.reset_where_done_jit = jax.jit(self.reset_where_done)
+        # Jitted single-game entry points (host GameState wrapper path).
+        self.reset_1 = jax.jit(self.reset)
+        self.step_1 = jax.jit(self.step)
+        self.valid_mask_1 = jax.jit(self.valid_action_mask)
 
     # --- transition functions (single game; vmap for batches) -------------
 
@@ -198,7 +201,12 @@ class TriangleEnv:
             key=key,
         )
         # Invalid action on a live game: forfeit (state frozen, game over).
-        next_invalid = state.replace(done=jnp.bool_(True), last_cleared=jnp.int32(0))
+        # Stepping an already-finished game is a true no-op, so last_cleared
+        # from the final real move survives.
+        next_invalid = state.replace(
+            done=jnp.bool_(True),
+            last_cleared=jnp.where(state.done, state.last_cleared, jnp.int32(0)),
+        )
         reward_invalid = jnp.where(
             state.done, 0.0, jnp.float32(cfg.PENALTY_GAME_OVER)
         )

@@ -64,8 +64,7 @@ class GameState:
         if _state is not None:
             self._state = _state
         else:
-            self._state = self._env.reset(jax.random.PRNGKey(initial_seed))
-        self._last_reward = 0.0
+            self._state = self._env.reset_1(jax.random.PRNGKey(initial_seed))
 
     # --- queries ----------------------------------------------------------
 
@@ -78,12 +77,12 @@ class GameState:
         return "no valid placement for any remaining shape"
 
     def valid_actions(self) -> list[int]:
-        mask = np.asarray(self._env.valid_action_mask(self._state))
+        mask = np.asarray(self._env.valid_mask_1(self._state))
         return [int(a) for a in np.flatnonzero(mask)]
 
     def valid_action_mask(self) -> np.ndarray:
         """(action_dim,) bool — dense form (TPU-native extension)."""
-        return np.asarray(self._env.valid_action_mask(self._state))
+        return np.asarray(self._env.valid_mask_1(self._state))
 
     def game_score(self) -> float:
         return float(self._state.score)
@@ -123,9 +122,8 @@ class GameState:
 
     def step(self, action: int) -> tuple[float, bool]:
         """Apply `action`; returns (reward, done)."""
-        state, reward, done = self._env.step(self._state, jnp.int32(action))
+        state, reward, done = self._env.step_1(self._state, jnp.int32(action))
         self._state = state
-        self._last_reward = float(reward)
         return float(reward), bool(done)
 
     def copy(self) -> "GameState":

@@ -63,6 +63,8 @@ def enumerate_shapes(min_tris: int, max_tris: int) -> list[tuple[Cell, ...]]:
     for size in range(1, max_tris + 1):
         if size >= min_tris:
             out.extend(sorted(level))
+        if size == max_tris:
+            break
         nxt: set[tuple[Cell, ...]] = set()
         for shape in level:
             cells = set(shape)

@@ -1,0 +1,21 @@
+"""Feature extraction: game state -> NN inputs.
+
+Reference surface: `alphatriangle/features/` (extractor + Numba grid
+kernels). Here the whole pipeline is vectorized jnp (`core`), with the
+host parity entry point in `extractor` and the scalar grid reductions in
+`grid_features`.
+"""
+
+from .core import FeatureExtractor, build_shape_feature_table, get_feature_extractor
+from .extractor import extract_state_features
+from .grid_features import bumpiness, column_heights, count_holes
+
+__all__ = [
+    "FeatureExtractor",
+    "build_shape_feature_table",
+    "bumpiness",
+    "column_heights",
+    "count_holes",
+    "extract_state_features",
+    "get_feature_extractor",
+]

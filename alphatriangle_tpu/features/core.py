@@ -1,0 +1,151 @@
+"""Batched state -> NN-input feature extraction (pure jnp).
+
+TPU-native redesign of the reference extractor
+(`alphatriangle/features/extractor.py:33-147`): the same 30-dim layout,
+but computed as vectorized array ops directly on the engine's
+struct-of-arrays `EnvState`, vmappable across a whole batch of games so
+self-play feature extraction is one fused XLA computation instead of a
+per-state Python/Numba pass.
+
+Feature layout (parity contract, verified by tests against
+`expected_other_features_dim`):
+- grid: (GRID_INPUT_CHANNELS, R, C) float32; channel 0 holds
+  1.0 occupied-playable / 0.0 empty / -1.0 death (extractor.py:33-46).
+- other_features, concatenated:
+  * per-slot shape features, 7 each (extractor.py:48-85): triangle
+    count / 5, up fraction, down fraction, bbox height / ROWS,
+    effective width / COLS (width * 0.75 + 0.25 — triangles overlap
+    horizontally), bbox row centroid / ROWS, bbox col centroid / COLS;
+    all clipped to [0, 1], zeros for empty slots.
+  * slot availability, NUM_SHAPE_SLOTS values (extractor.py:87-90).
+  * 6 scalars (extractor.py:92-118): score / 100 clipped to [-5, 5],
+    mean height / ROWS, max height / ROWS, holes / playable cells,
+    bumpiness / (COLS-1) / ROWS, step / 1000 clipped to [0, 1].
+
+Shape features depend only on the (static) shape bank, so they are
+precomputed host-side into an (S+1, 7) table and the device pass is a
+single gather by slot shape index.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from ..config.env_config import EnvConfig
+from ..config.model_config import ModelConfig
+from ..config.validation import EXPLICIT_FEATURES_DIM, FEATURES_PER_SHAPE
+from ..env.engine import EnvState, TriangleEnv
+from ..env.shapes import ShapeBank
+from . import grid_features
+
+
+def build_shape_feature_table(bank: ShapeBank, cfg: EnvConfig) -> np.ndarray:
+    """(S + 1, 7) float32: row s = features of shape s; last row = zeros.
+
+    The trailing zero row is the gather target for empty slots
+    (shape_idx == -1), so the device pass needs no branch.
+    """
+    table = np.zeros((bank.n_shapes + 1, FEATURES_PER_SHAPE), dtype=np.float32)
+    for s, cells in enumerate(bank.shapes):
+        n = len(cells)
+        ups = sum(1 for r, c in cells if (r + c) % 2 == 0)
+        min_r = min(r for r, _ in cells)
+        max_r = max(r for r, _ in cells)
+        min_c = min(c for _, c in cells)
+        max_c = max(c for _, c in cells)
+        height = max_r - min_r + 1
+        width_eff = (max_c - min_c + 1) * 0.75 + 0.25
+        table[s] = (
+            np.clip(n / 5.0, 0.0, 1.0),
+            ups / n,
+            (n - ups) / n,
+            np.clip(height / cfg.ROWS, 0.0, 1.0),
+            np.clip(width_eff / cfg.COLS, 0.0, 1.0),
+            np.clip(((min_r + max_r) / 2.0) / cfg.ROWS, 0.0, 1.0),
+            np.clip(((min_c + max_c) / 2.0) / cfg.COLS, 0.0, 1.0),
+        )
+    return table
+
+
+class FeatureExtractor:
+    """Static feature pipeline bound to one (EnvConfig, ModelConfig) pair.
+
+    Like `TriangleEnv`, instances are immutable and hold only
+    precomputed constants; `extract` / `extract_batch` are pure.
+    """
+
+    def __init__(self, env: TriangleEnv, model_config: ModelConfig):
+        self.env = env
+        self.model_config = model_config
+        expected = (
+            env.num_slots * FEATURES_PER_SHAPE
+            + env.num_slots
+            + EXPLICIT_FEATURES_DIM
+        )
+        if model_config.OTHER_NN_INPUT_FEATURES_DIM != expected:
+            raise ValueError(
+                f"ModelConfig.OTHER_NN_INPUT_FEATURES_DIM="
+                f"{model_config.OTHER_NN_INPUT_FEATURES_DIM} does not match "
+                f"the feature layout ({expected}) for this EnvConfig."
+            )
+        self.other_dim = expected
+        self._shape_table = jnp.asarray(
+            build_shape_feature_table(env.bank, env.cfg)
+        )
+        self._death = jnp.asarray(env.geometry.death)
+        self._n_playable = max(int((~env.geometry.death).sum()), 1)
+        self.extract_batch = jax.jit(jax.vmap(self.extract))
+
+    def extract(self, state: EnvState) -> tuple[Array, Array]:
+        """One game's (grid, other_features); vmap for batches."""
+        cfg = self.env.cfg
+        death = self._death
+
+        grid0 = jnp.where(
+            death, jnp.float32(-1.0), state.occupied.astype(jnp.float32)
+        )
+        grid = jnp.zeros(
+            (self.model_config.GRID_INPUT_CHANNELS, cfg.ROWS, cfg.COLS),
+            dtype=jnp.float32,
+        )
+        grid = grid.at[0].set(grid0)
+
+        # Shape features: gather from the static table; -1 -> zero row.
+        slot_rows = jnp.where(
+            state.shape_idx >= 0, state.shape_idx, self._shape_table.shape[0] - 1
+        )
+        shape_feats = self._shape_table[slot_rows].reshape(-1)  # (SLOTS*7,)
+        availability = (state.shape_idx >= 0).astype(jnp.float32)  # (SLOTS,)
+
+        heights = grid_features.column_heights(state.occupied, death)
+        holes = grid_features.count_holes(state.occupied, death, heights)
+        bump = grid_features.bumpiness(heights)
+        rows_f = jnp.float32(cfg.ROWS)
+        explicit = jnp.stack(
+            [
+                jnp.clip(state.score / 100.0, -5.0, 5.0),
+                heights.mean(dtype=jnp.float32) / rows_f,
+                heights.max().astype(jnp.float32) / rows_f,
+                holes.astype(jnp.float32) / self._n_playable,
+                (bump / max(cfg.COLS - 1, 1)) / rows_f,
+                jnp.clip(state.step_count.astype(jnp.float32) / 1000.0, 0.0, 1.0),
+            ]
+        )
+        other = jnp.concatenate([shape_feats, availability, explicit])
+        return grid, other
+
+
+# One extractor per (env-config, model-config) pair, mirroring the
+# engine cache in env.game_state.
+_EXTRACTOR_CACHE: dict[str, FeatureExtractor] = {}
+
+
+def get_feature_extractor(
+    env: TriangleEnv, model_config: ModelConfig
+) -> FeatureExtractor:
+    key = env.cfg.model_dump_json() + model_config.model_dump_json()
+    fe = _EXTRACTOR_CACHE.get(key)
+    if fe is None:
+        fe = _EXTRACTOR_CACHE[key] = FeatureExtractor(env, model_config)
+    return fe

@@ -1,0 +1,36 @@
+"""Host-side parity surface: `extract_state_features(game_state, model_config)`.
+
+Mirrors the reference entry point
+(`alphatriangle/features/extractor.py:150-171`) but delegates to the
+same jitted jnp pipeline the device self-play path uses
+(`features.core.FeatureExtractor`), so host and device features agree
+by construction. Includes the reference's finiteness scrub.
+"""
+
+import logging
+
+import numpy as np
+
+from ..config.model_config import ModelConfig
+from ..env.game_state import GameState
+from ..utils.types import StateType
+from .core import get_feature_extractor
+
+logger = logging.getLogger(__name__)
+
+
+def extract_state_features(
+    game_state: GameState, model_config: ModelConfig
+) -> StateType:
+    """GameState -> {grid (C,H,W), other_features (F,)} float32 NumPy."""
+    fe = get_feature_extractor(game_state._env, model_config)
+    grid, other = fe.extract(game_state._state)
+    grid_np = np.asarray(grid, dtype=np.float32)
+    other_np = np.asarray(other, dtype=np.float32)
+    if not np.all(np.isfinite(other_np)):
+        logger.error("Non-finite values in other_features; scrubbing to 0.")
+        other_np = np.nan_to_num(other_np, nan=0.0, posinf=0.0, neginf=0.0)
+    if not np.all(np.isfinite(grid_np)):
+        logger.error("Non-finite values in grid features; scrubbing to 0.")
+        grid_np = np.nan_to_num(grid_np, nan=0.0, posinf=0.0, neginf=0.0)
+    return {"grid": grid_np, "other_features": other_np}

@@ -1,0 +1,185 @@
+"""Feature-extraction contract tests.
+
+Pins the 30-dim layout of the reference extractor
+(`alphatriangle/features/extractor.py:33-147`) against the jnp pipeline:
+grid encoding, shape-feature table semantics, scalar grid features, and
+host/device agreement.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import (
+    EnvConfig,
+    ModelConfig,
+    expected_other_features_dim,
+)
+from alphatriangle_tpu.env import GameState, TriangleEnv
+from alphatriangle_tpu.features import (
+    build_shape_feature_table,
+    extract_state_features,
+    get_feature_extractor,
+)
+from alphatriangle_tpu.features.grid_features import (
+    bumpiness_np,
+    column_heights_np,
+    count_holes_np,
+)
+
+
+@pytest.fixture(scope="module")
+def env(tiny_env_config) -> TriangleEnv:
+    return TriangleEnv(tiny_env_config)
+
+
+@pytest.fixture(scope="module")
+def extractor(env, tiny_model_config):
+    return get_feature_extractor(env, tiny_model_config)
+
+
+def test_other_features_dim_matches_formula(extractor, tiny_env_config):
+    assert extractor.other_dim == expected_other_features_dim(tiny_env_config)
+
+
+def test_extract_shapes_and_dtypes(env, extractor, tiny_model_config, tiny_env_config):
+    state = env.reset(jax.random.PRNGKey(0))
+    grid, other = extractor.extract(state)
+    assert grid.shape == (
+        tiny_model_config.GRID_INPUT_CHANNELS,
+        tiny_env_config.ROWS,
+        tiny_env_config.COLS,
+    )
+    assert other.shape == (extractor.other_dim,)
+    assert grid.dtype == np.float32
+    assert other.dtype == np.float32
+
+
+def test_grid_encoding_values(tiny_model_config):
+    # Board with a death column: row windows exclude the last column.
+    cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 3), (0, 3), (0, 3)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+    )
+    model = ModelConfig(
+        **{
+            **tiny_model_config.model_dump(),
+            "OTHER_NN_INPUT_FEATURES_DIM": expected_other_features_dim(cfg),
+        }
+    )
+    gs = GameState(cfg, initial_seed=3)
+    # Play one valid action so something is occupied.
+    action = gs.valid_actions()[0]
+    gs.step(action)
+    feats = extract_state_features(gs, model)
+    grid = feats["grid"][0]
+    death = gs.get_grid_data_np()["death"]
+    occupied = gs.get_grid_data_np()["occupied"]
+    assert np.all(grid[death] == -1.0)
+    assert np.all(grid[occupied & ~death] == 1.0)
+    assert np.all(grid[~occupied & ~death] == 0.0)
+
+
+def test_shape_feature_table_semantics(env, tiny_env_config):
+    table = build_shape_feature_table(env.bank, tiny_env_config)
+    assert table.shape == (env.bank.n_shapes + 1, 7)
+    # Zero row for empty slots.
+    assert np.all(table[-1] == 0.0)
+    for s, cells in enumerate(env.bank.shapes):
+        n = len(cells)
+        ups = sum(1 for r, c in cells if (r + c) % 2 == 0)
+        assert table[s, 0] == pytest.approx(min(n / 5.0, 1.0))
+        assert table[s, 1] == pytest.approx(ups / n)
+        assert table[s, 2] == pytest.approx((n - ups) / n)
+        # Fractions sum to 1.
+        assert table[s, 1] + table[s, 2] == pytest.approx(1.0)
+    # All features normalized into [0, 1].
+    assert table.min() >= 0.0 and table.max() <= 1.0
+
+
+def test_grid_scalar_features_numpy_twins():
+    rng = np.random.default_rng(7)
+    occupied = rng.random((6, 5)) < 0.4
+    death = np.zeros((6, 5), dtype=bool)
+    death[:, 4] = True
+    heights = column_heights_np(occupied, death)
+    # Manual check, reference semantics: height = last occupied row + 1.
+    for c in range(5):
+        occ_rows = [r for r in range(6) if occupied[r, c] and not death[r, c]]
+        assert heights[c] == (max(occ_rows) + 1 if occ_rows else 0)
+    holes = count_holes_np(occupied, death, heights)
+    expected_holes = sum(
+        1
+        for c in range(5)
+        for r in range(heights[c])
+        if not occupied[r, c] and not death[r, c]
+    )
+    assert holes == expected_holes
+    assert bumpiness_np(heights) == sum(
+        abs(int(heights[i]) - int(heights[i + 1])) for i in range(4)
+    )
+
+
+def test_jnp_matches_numpy_grid_features(env, extractor):
+    from alphatriangle_tpu.features.grid_features import (
+        bumpiness,
+        column_heights,
+        count_holes,
+    )
+
+    rng = np.random.default_rng(11)
+    occupied = rng.random((env.rows, env.cols)) < 0.5
+    death = env.geometry.death
+    h_np = column_heights_np(occupied, death)
+    h_j = np.asarray(column_heights(occupied, death))
+    assert np.array_equal(h_np, h_j)
+    assert count_holes_np(occupied, death, h_np) == int(
+        count_holes(occupied, death, h_j)
+    )
+    assert bumpiness_np(h_np) == float(bumpiness(h_j))
+
+
+def test_batched_extraction_matches_single(env, extractor):
+    keys = jax.random.split(jax.random.PRNGKey(5), 8)
+    states = env.reset_batch(keys)
+    grids, others = extractor.extract_batch(states)
+    assert grids.shape[0] == 8 and others.shape[0] == 8
+    for i in range(8):
+        single = jax.tree_util.tree_map(lambda a, i=i: a[i], states)
+        g, o = extractor.extract(single)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(grids[i]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(others[i]), rtol=1e-6)
+
+
+def test_host_wrapper_matches_device_path(env, tiny_model_config):
+    gs = GameState(env.cfg, initial_seed=9)
+    for _ in range(3):
+        acts = gs.valid_actions()
+        if not acts:
+            break
+        gs.step(acts[0])
+    feats = extract_state_features(gs, tiny_model_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    g, o = fe.extract(gs._state)
+    np.testing.assert_allclose(feats["grid"], np.asarray(g))
+    np.testing.assert_allclose(feats["other_features"], np.asarray(o))
+    assert np.all(np.isfinite(feats["other_features"]))
+
+
+def test_explicit_features_after_play(env, tiny_model_config):
+    gs = GameState(env.cfg, initial_seed=1)
+    while not gs.is_over() and gs.current_step < 10:
+        gs.step(gs.valid_actions()[0])
+    feats = extract_state_features(gs, tiny_model_config)
+    other = feats["other_features"]
+    slots = env.num_slots
+    explicit = other[slots * 7 + slots :]
+    grid_data = gs.get_grid_data_np()
+    h = column_heights_np(grid_data["occupied"], grid_data["death"])
+    assert explicit[0] == pytest.approx(np.clip(gs.game_score() / 100.0, -5, 5))
+    assert explicit[1] == pytest.approx(h.mean() / env.rows)
+    assert explicit[2] == pytest.approx(h.max() / env.rows)
+    assert explicit[5] == pytest.approx(min(gs.current_step / 1000.0, 1.0))
