@@ -34,7 +34,10 @@ from jax import Array
 
 from ..config.env_config import EnvConfig
 from ..config.model_config import ModelConfig
-from ..config.validation import EXPLICIT_FEATURES_DIM, FEATURES_PER_SHAPE
+from ..config.validation import (
+    FEATURES_PER_SHAPE,
+    expected_other_features_dim,
+)
 from ..env.engine import EnvState, TriangleEnv
 from ..env.shapes import ShapeBank
 from . import grid_features
@@ -78,11 +81,7 @@ class FeatureExtractor:
     def __init__(self, env: TriangleEnv, model_config: ModelConfig):
         self.env = env
         self.model_config = model_config
-        expected = (
-            env.num_slots * FEATURES_PER_SHAPE
-            + env.num_slots
-            + EXPLICIT_FEATURES_DIM
-        )
+        expected = expected_other_features_dim(env.cfg)
         if model_config.OTHER_NN_INPUT_FEATURES_DIM != expected:
             raise ValueError(
                 f"ModelConfig.OTHER_NN_INPUT_FEATURES_DIM="
@@ -96,6 +95,7 @@ class FeatureExtractor:
         self._death = jnp.asarray(env.geometry.death)
         self._n_playable = max(int((~env.geometry.death).sum()), 1)
         self.extract_batch = jax.jit(jax.vmap(self.extract))
+        self.extract_1 = jax.jit(self.extract)
 
     def extract(self, state: EnvState) -> tuple[Array, Array]:
         """One game's (grid, other_features); vmap for batches."""

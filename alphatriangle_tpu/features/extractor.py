@@ -24,7 +24,7 @@ def extract_state_features(
 ) -> StateType:
     """GameState -> {grid (C,H,W), other_features (F,)} float32 NumPy."""
     fe = get_feature_extractor(game_state._env, model_config)
-    grid, other = fe.extract(game_state._state)
+    grid, other = fe.extract_1(game_state._state)
     grid_np = np.asarray(grid, dtype=np.float32)
     other_np = np.asarray(other, dtype=np.float32)
     if not np.all(np.isfinite(other_np)):

@@ -1,0 +1,25 @@
+"""Neural network layer: Flax model + evaluation wrapper.
+
+Reference surface: `alphatriangle/nn/` (`AlphaTriangleNet`,
+`NeuralNetwork`). See `model.py` / `network.py` docstrings for the
+TPU-first design notes.
+"""
+
+from .model import (
+    AlphaTriangleNet,
+    count_parameters,
+    expected_value_from_logits,
+    sinusoidal_positional_encoding,
+    value_support,
+)
+from .network import NetworkEvaluationError, NeuralNetwork
+
+__all__ = [
+    "AlphaTriangleNet",
+    "NetworkEvaluationError",
+    "NeuralNetwork",
+    "count_parameters",
+    "expected_value_from_logits",
+    "sinusoidal_positional_encoding",
+    "value_support",
+]

@@ -1,0 +1,277 @@
+"""Flax policy/value network (`AlphaTriangleNet` equivalent).
+
+Capability parity with the reference PyTorch architecture
+(`alphatriangle/nn/model.py:109-297`): conv trunk -> residual blocks ->
+optional pre-norm TransformerEncoder over the flattened spatial sequence
+(sinusoidal positional encoding) -> flatten -> concat `other_features`
+-> shared FC -> policy-logit head + C51 distributional value head.
+
+TPU-first redesign, not a translation:
+- NHWC conv layout (grid arrives (B, C, H, W) for API parity and is
+  transposed once on entry) so convs tile onto the MXU.
+- bfloat16 compute / float32 params via `ModelConfig.COMPUTE_DTYPE`;
+  logits are returned in float32.
+- Stateless GroupNorm by default (`NORM_TYPE="group"`): BatchNorm's
+  cross-example running statistics are hostile to dp-sharded pjit;
+  "batch" is still supported for parity (uses a `batch_stats`
+  collection and per-shard statistics).
+- Optional `jax.checkpoint` rematerialization of the residual and
+  transformer blocks (`ModelConfig.REMAT`) to trade FLOPs for HBM.
+- The spatial sequence is H*W tokens; positional encodings are baked as
+  a trace-time constant (reference: `nn/model.py:63-106`).
+"""
+
+from collections.abc import Callable
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import Array
+
+from ..config.model_config import ModelConfig
+
+_ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "ReLU": nn.relu,
+    "GELU": nn.gelu,
+    "SiLU": nn.silu,
+    "Tanh": jnp.tanh,
+    "Sigmoid": nn.sigmoid,
+}
+
+
+def _group_count(features: int, preferred: int = 8) -> int:
+    """Largest divisor of `features` that is <= preferred."""
+    g = min(preferred, features)
+    while features % g != 0:
+        g -= 1
+    return g
+
+
+def sinusoidal_positional_encoding(seq_len: int, dim: int) -> np.ndarray:
+    """(seq_len, dim) float32 sin/cos table (reference `nn/model.py:63-88`)."""
+    position = np.arange(seq_len, dtype=np.float32)[:, None]
+    div_term = np.exp(
+        np.arange(0, dim, 2, dtype=np.float32) * (-np.log(10000.0) / dim)
+    )
+    pe = np.zeros((seq_len, dim), dtype=np.float32)
+    pe[:, 0::2] = np.sin(position * div_term)
+    pe[:, 1::2] = np.cos(position * div_term[: pe[:, 1::2].shape[1]])
+    return pe
+
+
+class _Norm(nn.Module):
+    """Norm layer selected by `ModelConfig.NORM_TYPE`."""
+
+    norm_type: str
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        if self.norm_type == "group":
+            return nn.GroupNorm(
+                num_groups=_group_count(x.shape[-1]), dtype=self.dtype
+            )(x)
+        if self.norm_type == "layer":
+            return nn.LayerNorm(dtype=self.dtype)(x)
+        if self.norm_type == "batch":
+            return nn.BatchNorm(
+                use_running_average=not train, dtype=self.dtype, axis_name=None
+            )(x)
+        return x  # "none"
+
+
+class ConvBlock(nn.Module):
+    """Conv -> norm -> activation (reference `conv_block`, model.py:15-38)."""
+
+    features: int
+    kernel: int
+    stride: int
+    norm_type: str
+    act: Callable[[Array], Array]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        x = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            dtype=self.dtype,
+        )(x)
+        x = _Norm(self.norm_type, self.dtype)(x, train)
+        return self.act(x)
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs with skip connection (reference model.py:41-60)."""
+
+    features: int
+    norm_type: str
+    act: Callable[[Array], Array]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        residual = x
+        x = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = _Norm(self.norm_type, self.dtype)(x, train)
+        x = self.act(x)
+        x = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = _Norm(self.norm_type, self.dtype)(x, train)
+        return self.act(x + residual)
+
+
+class TransformerEncoderLayer(nn.Module):
+    """Pre-norm encoder layer (reference model.py:179-202, norm_first=True)."""
+
+    dim: int
+    heads: int
+    mlp_dim: int
+    act: Callable[[Array], Array]
+    dtype: jnp.dtype
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            deterministic=not train,
+        )(y, y)
+        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = self.act(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+
+
+class MLPHead(nn.Module):
+    """Dense stack with norm/act, then a linear output layer."""
+
+    hidden_dims: tuple[int, ...]
+    out_dim: int
+    norm_type: str
+    act: Callable[[Array], Array]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        for h in self.hidden_dims:
+            x = nn.Dense(h, dtype=self.dtype)(x)
+            x = _Norm(self.norm_type, self.dtype)(x, train)
+            x = self.act(x)
+        # Output layer in float32 for stable softmax/loss.
+        return nn.Dense(self.out_dim, dtype=jnp.float32)(x)
+
+
+class AlphaTriangleNet(nn.Module):
+    """Policy + C51 value network over (grid, other_features)."""
+
+    config: ModelConfig
+    action_dim: int
+
+    @nn.compact
+    def __call__(
+        self, grid: Array, other_features: Array, train: bool = False
+    ) -> tuple[Array, Array]:
+        """(B, C, H, W) grid + (B, F) extras -> (B, A) policy logits,
+        (B, NUM_VALUE_ATOMS) value-distribution logits (both float32)."""
+        cfg = self.config
+        dtype = jnp.dtype(cfg.COMPUTE_DTYPE)
+        act = _ACTIVATIONS[cfg.ACTIVATION_FUNCTION]
+
+        x = jnp.transpose(grid, (0, 2, 3, 1)).astype(dtype)  # NCHW -> NHWC
+
+        for f, k, s in zip(
+            cfg.CONV_FILTERS, cfg.CONV_KERNEL_SIZES, cfg.CONV_STRIDES, strict=True
+        ):
+            x = ConvBlock(f, k, s, cfg.NORM_TYPE, act, dtype)(x, train)
+
+        if cfg.NUM_RESIDUAL_BLOCKS > 0:
+            if x.shape[-1] != cfg.RESIDUAL_BLOCK_FILTERS:
+                x = ConvBlock(
+                    cfg.RESIDUAL_BLOCK_FILTERS, 1, 1, cfg.NORM_TYPE, act, dtype
+                )(x, train)
+            block = ResidualBlock
+            if cfg.REMAT:
+                block = nn.remat(ResidualBlock, static_argnums=(2,))
+            for _ in range(cfg.NUM_RESIDUAL_BLOCKS):
+                x = block(cfg.RESIDUAL_BLOCK_FILTERS, cfg.NORM_TYPE, act, dtype)(
+                    x, train
+                )
+
+        if cfg.USE_TRANSFORMER and cfg.TRANSFORMER_LAYERS > 0:
+            if x.shape[-1] != cfg.TRANSFORMER_DIM:
+                x = nn.Conv(cfg.TRANSFORMER_DIM, (1, 1), dtype=dtype)(x)
+            b, h, w, d = x.shape
+            tokens = x.reshape(b, h * w, d)
+            pe = jnp.asarray(
+                sinusoidal_positional_encoding(h * w, d), dtype=dtype
+            )
+            tokens = tokens + pe[None, :, :]
+            layer = TransformerEncoderLayer
+            if cfg.REMAT:
+                layer = nn.remat(TransformerEncoderLayer, static_argnums=(2,))
+            for _ in range(cfg.TRANSFORMER_LAYERS):
+                tokens = layer(
+                    cfg.TRANSFORMER_DIM,
+                    cfg.TRANSFORMER_HEADS,
+                    cfg.TRANSFORMER_FC_DIM,
+                    act,
+                    dtype,
+                )(tokens, train)
+            tokens = nn.LayerNorm(dtype=dtype)(tokens)
+            flat = tokens.reshape(b, -1)
+        else:
+            flat = x.reshape(x.shape[0], -1)
+
+        combined = jnp.concatenate(
+            [flat, other_features.astype(dtype)], axis=-1
+        )
+
+        shared = combined
+        for hdim in cfg.FC_DIMS_SHARED:
+            shared = nn.Dense(hdim, dtype=dtype)(shared)
+            shared = _Norm(cfg.NORM_TYPE, dtype)(shared, train)
+            shared = act(shared)
+
+        policy_logits = MLPHead(
+            tuple(cfg.POLICY_HEAD_DIMS),
+            self.action_dim,
+            cfg.NORM_TYPE,
+            act,
+            dtype,
+        )(shared, train)
+        value_logits = MLPHead(
+            tuple(cfg.VALUE_HEAD_DIMS),
+            cfg.NUM_VALUE_ATOMS,
+            cfg.NORM_TYPE,
+            act,
+            dtype,
+        )(shared, train)
+        return policy_logits.astype(jnp.float32), value_logits.astype(jnp.float32)
+
+
+def value_support(cfg: ModelConfig) -> Array:
+    """(NUM_VALUE_ATOMS,) float32 C51 atom support z_i."""
+    return jnp.linspace(
+        cfg.VALUE_MIN, cfg.VALUE_MAX, cfg.NUM_VALUE_ATOMS, dtype=jnp.float32
+    )
+
+
+def expected_value_from_logits(value_logits: Array, support: Array) -> Array:
+    """(..., atoms) logits -> (...,) expected scalar value sum(p_i * z_i)."""
+    probs = nn.softmax(value_logits, axis=-1)
+    return jnp.sum(probs * support, axis=-1)
+
+
+def count_parameters(params) -> int:
+    """Total scalar parameter count of a params pytree."""
+    import jax
+
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,171 @@
+"""Evaluation wrapper around `AlphaTriangleNet` (reference `NeuralNetwork`).
+
+Parity surface per `alphatriangle/nn/network.py:32-336`:
+`evaluate_state` / `evaluate_batch` (the `trimcts.AlphaZeroNetworkInterface`
+contract: policy dict + expected scalar value, finiteness guards,
+renormalization with uniform-over-valid-actions fallback) and
+`get_weights` / `set_weights`.
+
+TPU-native shape: the model is a pure Flax module; this wrapper owns a
+`variables` pytree and a single jitted batched apply. `torch.compile`
+gymnastics (`network.py:69-102`) disappear — jit is the default — and
+the uncompiled `_orig_model` aliasing (`network.py:53-54`) becomes
+simply "weights are an immutable pytree". `set_weights` bumps a version
+counter, the TPU replacement for the reference's Ray weight broadcast
+(SURVEY.md §2c: workers query device-resident params by version).
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.env_config import EnvConfig
+from ..config.model_config import ModelConfig
+from ..env.game_state import GameState
+from ..features.core import get_feature_extractor
+from ..features.extractor import extract_state_features
+from ..utils.types import ActionType
+from .model import AlphaTriangleNet, expected_value_from_logits, value_support
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkEvaluationError(Exception):
+    """Raised when network evaluation produces unusable outputs."""
+
+
+class NeuralNetwork:
+    """Owns model variables + jitted eval; presents the parity surface."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        env_config: EnvConfig,
+        seed: int = 0,
+        variables: dict | None = None,
+    ):
+        self.model_config = model_config
+        self.env_config = env_config
+        self.action_dim = env_config.action_dim
+        self.model = AlphaTriangleNet(model_config, self.action_dim)
+
+        self.num_atoms = model_config.NUM_VALUE_ATOMS
+        self.v_min = model_config.VALUE_MIN
+        self.v_max = model_config.VALUE_MAX
+        self.delta_z = (self.v_max - self.v_min) / (self.num_atoms - 1)
+        self.support = value_support(model_config)
+
+        if variables is None:
+            dummy_grid = jnp.zeros(
+                (
+                    1,
+                    model_config.GRID_INPUT_CHANNELS,
+                    env_config.ROWS,
+                    env_config.COLS,
+                ),
+                dtype=jnp.float32,
+            )
+            dummy_other = jnp.zeros(
+                (1, model_config.OTHER_NN_INPUT_FEATURES_DIM), dtype=jnp.float32
+            )
+            variables = self.model.init(
+                jax.random.PRNGKey(seed), dummy_grid, dummy_other, train=False
+            )
+        self.variables = variables
+        # Bumped by set_weights; self-play readers poll this instead of
+        # receiving broadcasts (replaces worker_manager.py:169-209).
+        self.weights_version = 0
+
+    # --- functional core --------------------------------------------------
+
+    @partial(jax.jit, static_argnums=0)
+    def _apply_eval(self, variables, grid, other):
+        policy_logits, value_logits = self.model.apply(
+            variables, grid, other, train=False
+        )
+        policy_probs = jax.nn.softmax(policy_logits, axis=-1)
+        values = expected_value_from_logits(value_logits, self.support)
+        return policy_logits, policy_probs, values
+
+    def evaluate_features(self, grid, other) -> tuple[np.ndarray, np.ndarray]:
+        """Batched (B,C,H,W)+(B,F) arrays (np or jnp) ->
+        (policy_probs (B,A), values (B,)) as NumPy.
+
+        Raises NetworkEvaluationError on non-finite network output
+        (reference guard semantics, `network.py:176-189`).
+        """
+        logits, probs, values = self._apply_eval(self.variables, grid, other)
+        logits_np = np.asarray(logits)
+        probs_np = np.asarray(probs)
+        values_np = np.asarray(values)
+        if not np.all(np.isfinite(logits_np)):
+            raise NetworkEvaluationError(
+                f"Non-finite policy logits (shape {logits_np.shape})."
+            )
+        if not np.all(np.isfinite(probs_np)) or not np.all(np.isfinite(values_np)):
+            raise NetworkEvaluationError("Non-finite policy probs or values.")
+        return probs_np, values_np
+
+    # --- parity surface ---------------------------------------------------
+
+    def _normalize_policy(
+        self, probs: np.ndarray, state: GameState, label: str
+    ) -> np.ndarray:
+        probs = np.maximum(probs, 0.0)
+        total = float(probs.sum())
+        if abs(total - 1.0) <= 1e-5:
+            return probs
+        if total > 1e-9:
+            return probs / total
+        valid = state.valid_actions()
+        if not valid:
+            raise NetworkEvaluationError(
+                f"{label}: policy sum near zero with no valid actions."
+            )
+        logger.warning("%s: policy sum near zero; uniform over valid.", label)
+        out = np.zeros_like(probs)
+        out[np.asarray(valid)] = 1.0 / len(valid)
+        return out
+
+    def evaluate_state(self, state: GameState) -> tuple[dict[ActionType, float], float]:
+        """Single-state eval -> (full {action: prob} mapping, expected value)."""
+        feats = extract_state_features(state, self.model_config)
+        probs, values = self.evaluate_features(
+            feats["grid"][None], feats["other_features"][None]
+        )
+        p = self._normalize_policy(probs[0], state, "evaluate_state")
+        return {i: float(x) for i, x in enumerate(p)}, float(values[0])
+
+    def evaluate_batch(
+        self, states: list[GameState]
+    ) -> list[tuple[dict[ActionType, float], float]]:
+        """Batch eval; one (policy dict, value) per input state."""
+        if not states:
+            return []
+        fe = get_feature_extractor(states[0]._env, self.model_config)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[s._state for s in states]
+        )
+        grids, others = fe.extract_batch(stacked)
+        probs, values = self.evaluate_features(grids, others)
+        out: list[tuple[dict[ActionType, float], float]] = []
+        for i, state in enumerate(states):
+            p = self._normalize_policy(probs[i], state, f"evaluate_batch[{i}]")
+            out.append(({a: float(x) for a, x in enumerate(p)}, float(values[i])))
+        return out
+
+    def get_weights(self) -> dict:
+        """Model variables as a host (NumPy) pytree."""
+        return jax.tree_util.tree_map(np.asarray, self.variables)
+
+    def set_weights(self, weights: dict) -> None:
+        """Install a variables pytree; bumps `weights_version`."""
+        self.variables = jax.tree_util.tree_map(jnp.asarray, weights)
+        self.weights_version += 1
+
+    @property
+    def params(self):
+        return self.variables["params"]

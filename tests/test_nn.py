@@ -1,0 +1,186 @@
+"""Model + evaluator contract tests.
+
+Mirrors the reference test matrix (`tests/nn/test_model.py:31-131`,
+`tests/nn/test_network.py:61-322`): forward shapes/dtypes with the
+transformer on and off, eval contracts (probs sum to 1, full action
+mapping, finite values), weight get/set round trip, NaN-input guard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import ModelConfig, expected_other_features_dim
+from alphatriangle_tpu.env import GameState
+from alphatriangle_tpu.nn import (
+    AlphaTriangleNet,
+    NetworkEvaluationError,
+    NeuralNetwork,
+    count_parameters,
+    expected_value_from_logits,
+    sinusoidal_positional_encoding,
+    value_support,
+)
+
+
+def _model_cfg(base: ModelConfig, **overrides) -> ModelConfig:
+    return ModelConfig(**{**base.model_dump(), **overrides})
+
+
+@pytest.fixture(scope="module", params=[False, True], ids=["cnn", "transformer"])
+def model_variant(request, tiny_model_config):
+    return _model_cfg(
+        tiny_model_config,
+        USE_TRANSFORMER=request.param,
+        TRANSFORMER_LAYERS=1 if request.param else 0,
+        NUM_RESIDUAL_BLOCKS=1,
+    )
+
+
+def test_forward_shapes_and_dtype(model_variant, tiny_env_config):
+    net = AlphaTriangleNet(model_variant, tiny_env_config.action_dim)
+    b = 3
+    grid = jnp.zeros((b, 1, tiny_env_config.ROWS, tiny_env_config.COLS))
+    other = jnp.zeros((b, model_variant.OTHER_NN_INPUT_FEATURES_DIM))
+    variables = net.init(jax.random.PRNGKey(0), grid, other, train=False)
+    pol, val = jax.jit(lambda v, g, o: net.apply(v, g, o, train=False))(
+        variables, grid, other
+    )
+    assert pol.shape == (b, tiny_env_config.action_dim)
+    assert val.shape == (b, model_variant.NUM_VALUE_ATOMS)
+    assert pol.dtype == jnp.float32 and val.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(pol)))
+
+
+def test_bfloat16_compute_path(tiny_model_config, tiny_env_config):
+    cfg = _model_cfg(tiny_model_config, COMPUTE_DTYPE="bfloat16")
+    net = AlphaTriangleNet(cfg, tiny_env_config.action_dim)
+    grid = jnp.zeros((2, 1, tiny_env_config.ROWS, tiny_env_config.COLS))
+    other = jnp.zeros((2, cfg.OTHER_NN_INPUT_FEATURES_DIM))
+    variables = net.init(jax.random.PRNGKey(0), grid, other)
+    pol, val = net.apply(variables, grid, other)
+    # Params stay f32, outputs are f32 despite bf16 internals.
+    leaf = jax.tree_util.tree_leaves(variables["params"])[0]
+    assert leaf.dtype == jnp.float32
+    assert pol.dtype == jnp.float32 and val.dtype == jnp.float32
+
+
+def test_batch_norm_variant_has_batch_stats(tiny_model_config, tiny_env_config):
+    cfg = _model_cfg(tiny_model_config, NORM_TYPE="batch")
+    net = AlphaTriangleNet(cfg, tiny_env_config.action_dim)
+    grid = jnp.zeros((2, 1, tiny_env_config.ROWS, tiny_env_config.COLS))
+    other = jnp.zeros((2, cfg.OTHER_NN_INPUT_FEATURES_DIM))
+    variables = net.init(jax.random.PRNGKey(0), grid, other, train=True)
+    assert "batch_stats" in variables
+    out, mutated = net.apply(
+        variables, grid, other, train=True,
+        mutable=["batch_stats"], rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert "batch_stats" in mutated
+
+
+def test_positional_encoding_table():
+    pe = sinusoidal_positional_encoding(10, 8)
+    assert pe.shape == (10, 8)
+    # Row 0 is sin(0)=0 interleaved with cos(0)=1.
+    np.testing.assert_allclose(pe[0, 0::2], 0.0, atol=1e-7)
+    np.testing.assert_allclose(pe[0, 1::2], 1.0, atol=1e-7)
+    assert np.all(np.abs(pe) <= 1.0)
+
+
+def test_value_support_and_expectation(tiny_model_config):
+    support = value_support(tiny_model_config)
+    assert support.shape == (tiny_model_config.NUM_VALUE_ATOMS,)
+    assert float(support[0]) == tiny_model_config.VALUE_MIN
+    assert float(support[-1]) == tiny_model_config.VALUE_MAX
+    # A one-hot distribution on atom k has expected value z_k.
+    logits = jnp.full((1, tiny_model_config.NUM_VALUE_ATOMS), -1e9)
+    logits = logits.at[0, 3].set(0.0)
+    ev = expected_value_from_logits(logits, support)
+    assert float(ev[0]) == pytest.approx(float(support[3]), rel=1e-5)
+
+
+@pytest.fixture(scope="module")
+def network(tiny_model_config, tiny_env_config) -> NeuralNetwork:
+    return NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+
+
+@pytest.fixture()
+def game(tiny_env_config) -> GameState:
+    return GameState(tiny_env_config, initial_seed=4)
+
+
+def test_evaluate_state_contract(network, game, tiny_env_config):
+    policy, value = network.evaluate_state(game)
+    assert len(policy) == tiny_env_config.action_dim
+    assert sum(policy.values()) == pytest.approx(1.0, abs=1e-4)
+    assert all(p >= 0 for p in policy.values())
+    assert network.v_min <= value <= network.v_max
+    assert np.isfinite(value)
+
+
+def test_evaluate_batch_contract(network, tiny_env_config):
+    states = [GameState(tiny_env_config, initial_seed=s) for s in range(5)]
+    results = network.evaluate_batch(states)
+    assert len(results) == 5
+    for policy, value in results:
+        assert sum(policy.values()) == pytest.approx(1.0, abs=1e-4)
+        assert np.isfinite(value)
+    assert network.evaluate_batch([]) == []
+
+
+def test_evaluate_batch_matches_single(network, tiny_env_config):
+    state = GameState(tiny_env_config, initial_seed=7)
+    single_policy, single_value = network.evaluate_state(state)
+    [(batch_policy, batch_value)] = network.evaluate_batch([state])
+    assert single_value == pytest.approx(batch_value, abs=1e-5)
+    np.testing.assert_allclose(
+        np.array(list(single_policy.values())),
+        np.array(list(batch_policy.values())),
+        atol=1e-5,
+    )
+
+
+def test_weights_roundtrip_and_version(network, game):
+    w = network.get_weights()
+    policy_before, value_before = network.evaluate_state(game)
+    v0 = network.weights_version
+    # Perturb weights -> output changes; restore -> output matches.
+    perturbed = jax.tree_util.tree_map(lambda a: a + 0.5, w)
+    network.set_weights(perturbed)
+    assert network.weights_version == v0 + 1
+    _, value_perturbed = network.evaluate_state(game)
+    network.set_weights(w)
+    policy_after, value_after = network.evaluate_state(game)
+    assert value_after == pytest.approx(value_before, abs=1e-5)
+    assert value_perturbed != pytest.approx(value_before, abs=1e-6)
+    np.testing.assert_allclose(
+        np.array(list(policy_before.values())),
+        np.array(list(policy_after.values())),
+        atol=1e-6,
+    )
+
+
+def test_nan_features_raise(network, game, monkeypatch):
+    import alphatriangle_tpu.nn.network as netmod
+
+    def bad_extract(gs, mc):
+        feats = extract_real(gs, mc)
+        feats["other_features"] = np.full_like(feats["other_features"], np.nan)
+        return feats
+
+    extract_real = netmod.extract_state_features
+    monkeypatch.setattr(netmod, "extract_state_features", bad_extract)
+    with pytest.raises(NetworkEvaluationError):
+        network.evaluate_state(game)
+
+
+def test_count_parameters(network):
+    n = count_parameters(network.params)
+    assert n > 0
+    total = sum(
+        int(np.prod(p.shape))
+        for p in jax.tree_util.tree_leaves(network.variables["params"])
+    )
+    assert n == total
