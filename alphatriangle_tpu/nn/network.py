@@ -16,7 +16,6 @@ counter, the TPU replacement for the reference's Ray weight broadcast
 """
 
 import logging
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -79,16 +78,18 @@ class NeuralNetwork:
         # receiving broadcasts (replaces worker_manager.py:169-209).
         self.weights_version = 0
 
-    # --- functional core --------------------------------------------------
+        # Jit a per-instance closure (not a method with static self):
+        # the compile cache then dies with the instance instead of
+        # pinning every instance's weights in the class-level jit cache.
+        def _apply(variables, grid, other):
+            policy_logits, value_logits = self.model.apply(
+                variables, grid, other, train=False
+            )
+            policy_probs = jax.nn.softmax(policy_logits, axis=-1)
+            values = expected_value_from_logits(value_logits, self.support)
+            return policy_logits, policy_probs, values
 
-    @partial(jax.jit, static_argnums=0)
-    def _apply_eval(self, variables, grid, other):
-        policy_logits, value_logits = self.model.apply(
-            variables, grid, other, train=False
-        )
-        policy_probs = jax.nn.softmax(policy_logits, axis=-1)
-        values = expected_value_from_logits(value_logits, self.support)
-        return policy_logits, policy_probs, values
+        self._apply_eval = jax.jit(_apply)
 
     def evaluate_features(self, grid, other) -> tuple[np.ndarray, np.ndarray]:
         """Batched (B,C,H,W)+(B,F) arrays (np or jnp) ->
@@ -142,15 +143,25 @@ class NeuralNetwork:
     def evaluate_batch(
         self, states: list[GameState]
     ) -> list[tuple[dict[ActionType, float], float]]:
-        """Batch eval; one (policy dict, value) per input state."""
+        """Batch eval; one (policy dict, value) per input state.
+
+        Inputs are padded to the next power-of-two batch size so jitted
+        shapes come from a small bucket set instead of recompiling for
+        every distinct len(states) an MCTS leaf wave produces.
+        """
         if not states:
             return []
+        n = len(states)
+        bucket = 1 << (n - 1).bit_length()
+        padded = [s._state for s in states]
+        padded.extend([states[0]._state] * (bucket - n))
         fe = get_feature_extractor(states[0]._env, self.model_config)
         stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *[s._state for s in states]
+            lambda *xs: jnp.stack(xs), *padded
         )
         grids, others = fe.extract_batch(stacked)
         probs, values = self.evaluate_features(grids, others)
+        probs, values = probs[:n], values[:n]
         out: list[tuple[dict[ActionType, float], float]] = []
         for i, state in enumerate(states):
             p = self._normalize_policy(probs[i], state, f"evaluate_batch[{i}]")

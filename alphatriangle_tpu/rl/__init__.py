@@ -1,0 +1,6 @@
+"""RL core: replay buffer, trainer, self-play (reference `alphatriangle/rl/`)."""
+
+from .buffer import DenseSample, ExperienceBuffer
+from .types import SelfPlayResult
+
+__all__ = ["DenseSample", "ExperienceBuffer", "SelfPlayResult"]
