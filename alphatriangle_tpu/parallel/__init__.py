@@ -1,0 +1,15 @@
+"""Mesh / sharding utilities (no reference equivalent — SURVEY.md §2c).
+
+The reference's "distributed backend" is Ray RPC with a single-device
+learner; here parallelism is expressed as `jax.sharding` over a named
+`Mesh` and XLA inserts the ICI collectives.
+"""
+
+from .sharding import (
+    batch_sharding,
+    replicated,
+    shard_batch,
+    state_shardings,
+)
+
+__all__ = ["batch_sharding", "replicated", "shard_batch", "state_shardings"]

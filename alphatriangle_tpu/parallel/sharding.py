@@ -1,0 +1,39 @@
+"""NamedSharding helpers for the (dp, mdl) mesh.
+
+The learner's sharding contract (SURVEY.md §2c "TPU-native equivalent"):
+- model/optimizer state is **replicated** across the mesh;
+- training batches are **sharded on the dp axis** (leading dim);
+- gradients are reduced by XLA-inserted collectives over ICI — the code
+  never spells a psum, it falls out of jit over sharded inputs.
+
+Everything here works identically on a real TPU mesh and on the
+virtual 8-CPU-device mesh the tests use.
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params, opt state, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, dp_axis: str = "dp") -> NamedSharding:
+    """Leading-dim sharding over the data-parallel axis."""
+    return NamedSharding(mesh, P(dp_axis))
+
+
+def state_shardings(mesh: Mesh, state) -> object:
+    """A pytree of replicated shardings matching `state`'s structure."""
+    rep = replicated(mesh)
+    return jax.tree_util.tree_map(lambda _: rep, state)
+
+
+def shard_batch(mesh: Mesh, batch, dp_axis: str = "dp"):
+    """Place a host batch pytree onto the mesh, sharded on `dp_axis`.
+
+    Every leaf's leading dimension must be divisible by the dp axis size.
+    """
+    sh = batch_sharding(mesh, dp_axis)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)

@@ -273,7 +273,11 @@ class ExperienceBuffer:
             prios = state.get("priorities")
             if prios is None:
                 prios = np.ones(n, dtype=np.float64)
-            self.tree.data_pointer = 0
-            self.tree.update_batch(np.arange(n), np.asarray(prios[:n], dtype=np.float64))
+            # Write the full leaf range: slots >= n must be zeroed, or a
+            # smaller snapshot restored over a fuller tree leaves stale
+            # priorities inflating total_priority and hijacking sampling.
+            full = np.zeros(self.capacity, dtype=np.float64)
+            full[:n] = np.asarray(prios[:n], dtype=np.float64)
+            self.tree.update_batch(np.arange(self.capacity), full)
             self.tree.data_pointer = self._pos
             self.tree.n_entries = n

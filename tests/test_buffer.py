@@ -190,6 +190,23 @@ class TestPersistence:
         out = buf2.sample(4, current_train_step=0)
         assert out is not None
 
+    def test_smaller_snapshot_over_fuller_buffer_clears_stale_leaves(self):
+        small = ExperienceBuffer(per_cfg())
+        small.add_dense(*make_dense(8))
+        snap = small.get_state()
+
+        full = ExperienceBuffer(per_cfg())
+        full.add_dense(*make_dense(20, seed=9))
+        full.update_priorities(np.arange(20), np.full(20, 10.0))
+        full.set_state(snap)
+        assert len(full) == 8
+        # Stale leaves zeroed: total priority reflects only the 8 slots.
+        assert full.tree.total_priority == pytest.approx(
+            full.tree.tree[full.tree._cap2 : full.tree._cap2 + 8].sum()
+        )
+        out = full.sample(4, current_train_step=0)
+        assert np.all(out["indices"] < 8)
+
 
 class TestSelfPlayResult:
     def test_valid_rows_kept_invalid_dropped(self):

@@ -1,0 +1,216 @@
+"""Learner tests (reference matrix: `tests/rl/test_trainer.py:135-270`)
+plus the multi-device dp-sharding correctness story from VERDICT.md #3:
+an 8-virtual-device train step keeps replicas bit-identical and matches
+the single-device result."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import MeshConfig, TrainConfig
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.rl.trainer import (
+    Trainer,
+    make_lr_schedule,
+    make_optimizer,
+    project_to_support,
+)
+
+B, A = 8, 12
+
+
+@pytest.fixture(scope="module")
+def network(tiny_model_config, tiny_env_config):
+    return NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+
+
+def make_batch(n=B, seed=0, weights=None):
+    rng = np.random.default_rng(seed)
+    policy = rng.random((n, A)).astype(np.float32)
+    policy /= policy.sum(axis=1, keepdims=True)
+    return {
+        "grid": rng.integers(-1, 2, size=(n, 1, 3, 4)).astype(np.float32),
+        "other_features": rng.random((n, 14), dtype=np.float32),
+        "policy_target": policy,
+        "value_target": rng.uniform(-5, 5, n).astype(np.float32),
+        "weights": (
+            np.ones(n, dtype=np.float32) if weights is None else weights
+        ),
+    }
+
+
+class TestSchedules:
+    def test_cosine_endpoints(self):
+        cfg = TrainConfig(
+            MAX_TRAINING_STEPS=1000,
+            LR_SCHEDULER_TYPE="CosineAnnealingLR",
+            LEARNING_RATE=1e-3,
+            LR_SCHEDULER_ETA_MIN=1e-6,
+            RUN_NAME="t",
+        )
+        sched = make_lr_schedule(cfg)
+        assert float(sched(0)) == pytest.approx(1e-3)
+        assert float(sched(1000)) == pytest.approx(1e-6, rel=1e-3)
+
+    def test_step_lr_staircase(self):
+        cfg = TrainConfig(
+            LR_SCHEDULER_TYPE="StepLR",
+            LR_SCHEDULER_STEP_SIZE=10,
+            LR_SCHEDULER_GAMMA=0.5,
+            LEARNING_RATE=1e-3,
+            RUN_NAME="t",
+        )
+        sched = make_lr_schedule(cfg)
+        assert float(sched(9)) == pytest.approx(1e-3)
+        assert float(sched(10)) == pytest.approx(5e-4)
+        assert float(sched(25)) == pytest.approx(2.5e-4)
+
+    def test_optimizer_types(self):
+        for opt_type in ["Adam", "AdamW", "SGD"]:
+            cfg = TrainConfig(OPTIMIZER_TYPE=opt_type, RUN_NAME="t")
+            opt = make_optimizer(cfg)
+            params = {"w": jnp.ones(3)}
+            state = opt.init(params)
+            grads = {"w": jnp.ones(3)}
+            updates, _ = opt.update(grads, state, params)
+            assert jnp.all(jnp.isfinite(updates["w"]))
+
+
+class TestProjection:
+    def test_exact_atom_is_one_hot(self):
+        # support [-10, 10], 51 atoms => atom spacing 0.4; -10 is atom 0.
+        out = project_to_support(jnp.array([-10.0, 10.0, 0.0]), 51, -10, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-6)
+        assert out[0, 0] == 1.0
+        assert out[1, 50] == 1.0
+        assert out[2, 25] == 1.0
+
+    def test_between_atoms_two_hot(self):
+        # 11 atoms on [-1, 1] => spacing 0.2; 0.15 sits 3/4 between atoms 5,6.
+        out = project_to_support(jnp.array([0.15]), 11, -1, 1)
+        assert out[0, 5] == pytest.approx(0.25, abs=1e-5)
+        assert out[0, 6] == pytest.approx(0.75, abs=1e-5)
+        assert out[0].sum() == pytest.approx(1.0)
+
+    def test_out_of_range_clipped(self):
+        out = project_to_support(jnp.array([-100.0, 100.0]), 11, -1, 1)
+        assert out[0, 0] == 1.0
+        assert out[1, 10] == 1.0
+
+
+class TestTrainStep:
+    def test_params_change_and_metrics(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        before = jax.tree_util.tree_map(np.asarray, trainer.state.params)
+        out = trainer.train_step(make_batch())
+        assert out is not None
+        metrics, td = out
+        assert td.shape == (B,)
+        assert np.all(np.isfinite(td)) and np.all(td >= 0)
+        for key in ["total_loss", "policy_loss", "value_loss", "entropy"]:
+            assert np.isfinite(metrics[key])
+        after = trainer.state.params
+        changed = jax.tree_util.tree_map(
+            lambda a, b: not np.allclose(a, np.asarray(b)), before, after
+        )
+        assert any(jax.tree_util.tree_leaves(changed))
+        assert trainer.global_step == 1
+
+    def test_empty_batch_returns_none(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        assert trainer.train_step(make_batch(0)) is None
+
+    def test_zero_weights_zero_grads(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        out = trainer.train_step(
+            make_batch(weights=np.zeros(B, dtype=np.float32))
+        )
+        assert out is not None
+        assert out[0]["grad_norm"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_lr_follows_schedule(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        lr0 = trainer.get_current_lr()
+        for _ in range(3):
+            trainer.train_step(make_batch())
+        assert trainer.get_current_lr() < lr0  # cosine decays
+
+    def test_sync_to_network_bumps_version(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        v0 = network.weights_version
+        trainer.train_step(make_batch())
+        assert trainer.sync_to_network() == v0 + 1
+        # The wrapper now evaluates with the trained params.
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree_util.tree_leaves(network.params)[0]),
+            np.asarray(jax.tree_util.tree_leaves(trainer.state.params)[0]),
+        )
+
+
+class TestBatchNormPath:
+    def test_batch_stats_updated(self, tiny_model_config, tiny_env_config):
+        bn_cfg = tiny_model_config.model_copy(update={"NORM_TYPE": "batch"})
+        net = NeuralNetwork(bn_cfg, tiny_env_config, seed=0)
+        cfg = TrainConfig(
+            BATCH_SIZE=4, BUFFER_CAPACITY=100, MIN_BUFFER_SIZE_TO_TRAIN=10,
+            USE_PER=False, MAX_TRAINING_STEPS=10, RUN_NAME="bn",
+        )
+        trainer = Trainer(net, cfg)
+        assert trainer.state.batch_stats
+        before = jax.tree_util.tree_map(np.asarray, trainer.state.batch_stats)
+        trainer.train_step(make_batch())
+        changed = jax.tree_util.tree_map(
+            lambda a, b: not np.allclose(a, np.asarray(b)),
+            before,
+            trainer.state.batch_stats,
+        )
+        assert any(jax.tree_util.tree_leaves(changed))
+
+
+class TestMultiDevice:
+    """VERDICT #3 'Done =' criteria: dp-sharded batch, params change,
+    replicas stay bit-identical, and the result matches single-device."""
+
+    def test_8dev_step_matches_single_device(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        assert len(jax.devices()) == 8
+        mesh = MeshConfig(DP_SIZE=8, MDL_SIZE=1).build_mesh()
+        batch = make_batch(16, seed=7)
+
+        net1 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=3)
+        t_single = Trainer(net1, tiny_train_config)
+        t_single.train_step(batch)
+        single_params = jax.tree_util.tree_map(
+            np.asarray, t_single.state.params
+        )
+
+        net8 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=3)
+        t_mesh = Trainer(net8, tiny_train_config, mesh=mesh)
+        out = t_mesh.train_step(batch)
+        assert out is not None
+
+        # Replicas bit-identical across all 8 devices (the grad
+        # all-reduce actually ran and agreed).
+        leaf = jax.tree_util.tree_leaves(t_mesh.state.params)[0]
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        assert len(shards) == 8
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+        # Multi-device result matches the single-device step.
+        mesh_params = jax.tree_util.tree_map(np.asarray, t_mesh.state.params)
+        flat_s = jax.tree_util.tree_leaves(single_params)
+        flat_m = jax.tree_util.tree_leaves(mesh_params)
+        for a, b in zip(flat_s, flat_m, strict=True):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+    def test_indivisible_batch_raises(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        mesh = MeshConfig(DP_SIZE=8, MDL_SIZE=1).build_mesh()
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config, mesh=mesh)
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.train_step(make_batch(6))
