@@ -1,0 +1,25 @@
+"""Batched AlphaZero MCTS (trimcts equivalent, SURVEY.md §2b).
+
+The reference's C++ search walks one tree per worker process and ships
+`mcts_batch_size=32` leaves at a time back into Python for CPU net
+evaluation. Here the search itself is a jitted JAX program over fixed
+shape tree-of-arrays state: B games search in lockstep and every
+simulation evaluates all B leaves in ONE batched network call on the
+MXU — the architectural change BASELINE.md names as the games/hour
+make-or-break.
+"""
+
+from .helpers import (
+    PolicyGenerationError,
+    policy_target_from_visits,
+    select_action_from_visits,
+)
+from .search import BatchedMCTS, SearchOutput
+
+__all__ = [
+    "BatchedMCTS",
+    "PolicyGenerationError",
+    "SearchOutput",
+    "policy_target_from_visits",
+    "select_action_from_visits",
+]

@@ -1,0 +1,97 @@
+"""Visit-count post-processing (reference
+`alphatriangle/rl/self_play/mcts_helpers.py:19-189`).
+
+Dense, batched redesign: the reference converts `dict[int, int]` visit
+maps with Python loops; here visit counts are already dense `(B, A)`
+arrays out of the batched search, so temperature selection and policy
+targets are vectorized jnp ops usable inside jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PolicyGenerationError(Exception):
+    """Raised when no usable policy can be derived from visit counts
+    (reference `mcts_helpers.py:13-16`)."""
+
+
+def policy_target_from_visits(
+    visit_counts: jax.Array, valid_mask: jax.Array | None = None
+) -> jax.Array:
+    """(..., A) visit counts -> normalized dense policy targets.
+
+    Rows with zero total visits fall back to uniform over valid actions
+    (or all actions when no mask is given) instead of NaN.
+    """
+    counts = jnp.asarray(visit_counts, dtype=jnp.float32)
+    total = counts.sum(axis=-1, keepdims=True)
+    if valid_mask is not None:
+        fallback = valid_mask.astype(jnp.float32)
+    else:
+        fallback = jnp.ones_like(counts)
+    fallback = fallback / jnp.maximum(fallback.sum(axis=-1, keepdims=True), 1.0)
+    return jnp.where(total > 0, counts / jnp.maximum(total, 1e-9), fallback)
+
+
+def select_action_from_visits(
+    visit_counts: jax.Array,
+    temperature: jax.Array | float,
+    rng: jax.Array,
+) -> jax.Array:
+    """(B, A) visit counts -> (B,) sampled actions.
+
+    Temperature semantics follow the reference (`mcts_helpers.py:19-102`):
+    T == 0 -> greedy argmax; T > 0 -> sample ∝ counts^(1/T). Zero-count
+    actions are never selected (probability exactly 0); a row with no
+    visits at all yields the sentinel -1 (jit cannot raise — callers
+    must mask or clamp, e.g. finished games in a batch). `temperature`
+    may be a scalar or a per-game (B,) array (move-indexed schedules).
+    """
+    counts = jnp.asarray(visit_counts, dtype=jnp.float32)
+    temp = jnp.broadcast_to(
+        jnp.asarray(temperature, dtype=jnp.float32), counts.shape[:-1]
+    )[..., None]
+    log_counts = jnp.where(counts > 0, jnp.log(counts), -jnp.inf)
+    greedy = jnp.argmax(log_counts, axis=-1)
+    # Sampling path: logits = log(counts) / T, safe T to avoid /0.
+    safe_temp = jnp.maximum(temp, 1e-6)
+    gumbel = jax.random.gumbel(rng, counts.shape)
+    sampled = jnp.argmax(log_counts / safe_temp + gumbel, axis=-1)
+    chosen = jnp.where(temp[..., 0] <= 1e-8, greedy, sampled)
+    any_visits = counts.sum(axis=-1) > 0
+    return jnp.where(any_visits, chosen, -1).astype(jnp.int32)
+
+
+# --- host-side dict adapters (parity with the reference surface) ----------
+
+
+def visits_dict_to_dense(
+    visits: dict[int, int], action_dim: int
+) -> np.ndarray:
+    """{action: count} -> dense (A,) float32 counts."""
+    dense = np.zeros(action_dim, dtype=np.float32)
+    for a, c in visits.items():
+        if not 0 <= a < action_dim:
+            raise PolicyGenerationError(
+                f"Visit action {a} outside action space [0, {action_dim})."
+            )
+        dense[a] = c
+    return dense
+
+
+def select_action_from_visits_dict(
+    visits: dict[int, int],
+    action_dim: int,
+    temperature: float,
+    seed: int = 0,
+) -> int:
+    """Reference-shaped single-game selection over a visit dict."""
+    if not visits or sum(visits.values()) <= 0:
+        raise PolicyGenerationError("No visits to select an action from.")
+    dense = visits_dict_to_dense(visits, action_dim)
+    action = select_action_from_visits(
+        dense[None], temperature, jax.random.PRNGKey(seed)
+    )[0]
+    return int(action)

@@ -1,0 +1,335 @@
+"""Batched PUCT search over fixed-shape tree arrays.
+
+Functional equivalent of the observed trimcts surface
+(`alphatriangle/config/mcts_config.py:67-77`,
+`alphatriangle/rl/self_play/worker.py:273-280`): PUCT selection with
+cpuct, Dirichlet root noise, max-depth cutoff, discounted value backup,
+dense visit-count extraction.
+
+TPU-first design, not a translation of the C++ pointer tree:
+- A search over B games is ONE jitted computation. Tree state is a
+  struct-of-arrays pytree with leading dims (B, N) where
+  N = max_simulations + 1 node slots (root + one expansion per sim).
+- Each simulation does: vmapped PUCT descent (bounded `lax.while_loop`)
+  -> one batched env.step for all B selected edges -> one batched
+  feature-extract + network apply for all B new leaves (the MXU call)
+  -> vmapped discounted backup along parent chains.
+- All shapes static; no Python control flow inside jit.
+- Terminal nodes evaluate to value 0 and step as no-ops (the engine
+  freezes finished games), so finished games in a batch stay in
+  lockstep at zero extra cost.
+- Subtree reuse (the reference's opaque tree handle) is intentionally
+  absent: with B games searched per dispatch, re-searching from the
+  root each move keeps shapes static and the MXU saturated; the
+  root-prior already encodes the network's (fresher) knowledge.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config.mcts_config import MCTSConfig
+from ..env.engine import EnvState, TriangleEnv
+from ..features.core import FeatureExtractor
+
+
+@struct.dataclass
+class Tree:
+    """Search-tree arrays for one game (batched: add a leading B dim)."""
+
+    node_state: EnvState  # (N, ...) game state at each node
+    visits: jax.Array  # (N,) int32
+    value_sum: jax.Array  # (N,) float32 sum of backed-up returns
+    prior: jax.Array  # (N, A) float32 masked policy priors
+    valid: jax.Array  # (N, A) bool valid-action masks
+    children: jax.Array  # (N, A) int32 child node index; -1 = unexpanded
+    parent: jax.Array  # (N,) int32; -1 at root
+    parent_action: jax.Array  # (N,) int32; -1 at root
+    reward: jax.Array  # (N,) float32 reward on the edge into this node
+    terminal: jax.Array  # (N,) bool
+
+
+@struct.dataclass
+class SearchOutput:
+    """Result of one batched search."""
+
+    visit_counts: jax.Array  # (B, A) float32 root child visit counts
+    root_value: jax.Array  # (B,) float32 mean backed-up root value
+    root_prior: jax.Array  # (B, A) float32 noisy root prior (debug)
+    total_simulations: jax.Array  # () int32
+
+
+class BatchedMCTS:
+    """PUCT search bound to (env, features, model); `search` is jitted.
+
+    `evaluate` contract: the Flax model applied to extracted features,
+    returning (policy_logits, value_logits -> scalar values) — the same
+    role as the reference's `AlphaZeroNetworkInterface.evaluate_batch`
+    (`alphatriangle/nn/network.py:242-318`) but traced into the search.
+    """
+
+    def __init__(
+        self,
+        env: TriangleEnv,
+        extractor: FeatureExtractor,
+        model: Any,
+        config: MCTSConfig,
+        value_support: jax.Array,
+    ):
+        self.env = env
+        self.extractor = extractor
+        self.model = model
+        self.config = config
+        self.support = value_support
+        self.num_nodes = config.max_simulations + 1
+        self.action_dim = env.action_dim
+        self.search = jax.jit(self._search)
+
+    # --- network evaluation ----------------------------------------------
+
+    def _evaluate(self, variables, states: EnvState):
+        """Batched leaf eval: states (B-leading) -> (priors (B,A), values (B,)).
+
+        Priors are masked to valid actions and renormalized (uniform over
+        valid when the network mass on valid actions vanishes — the
+        reference's fallback, `nn/network.py:200-215`).
+        """
+        grids, others = jax.vmap(self.extractor.extract)(states)
+        policy_logits, value_logits = self.model.apply(
+            variables, grids, others, train=False
+        )
+        valid = jax.vmap(self.env.valid_action_mask)(states)  # (B, A)
+        masked_logits = jnp.where(valid, policy_logits, -jnp.inf)
+        # Softmax over valid actions only; all-invalid rows -> zeros.
+        any_valid = valid.any(axis=-1, keepdims=True)
+        safe_logits = jnp.where(any_valid, masked_logits, 0.0)
+        priors = jax.nn.softmax(safe_logits, axis=-1)
+        priors = jnp.where(valid, priors, 0.0)
+        norm = priors.sum(axis=-1, keepdims=True)
+        uniform = valid.astype(jnp.float32) / jnp.maximum(
+            valid.sum(axis=-1, keepdims=True), 1
+        )
+        priors = jnp.where(norm > 1e-9, priors / jnp.maximum(norm, 1e-9), uniform)
+        value_probs = jax.nn.softmax(value_logits, axis=-1)
+        values = jnp.sum(value_probs * self.support, axis=-1)
+        return priors, values, valid
+
+    # --- per-tree primitives (single game; vmapped) -----------------------
+
+    def _puct_scores(self, tree: Tree, node: jax.Array) -> jax.Array:
+        """(A,) PUCT score of each action at `node`."""
+        cfg = self.config
+        child = tree.children[node]  # (A,)
+        cidx = jnp.maximum(child, 0)
+        expanded = child >= 0
+        c_visits = jnp.where(expanded, tree.visits[cidx], 0)
+        c_value = jnp.where(
+            c_visits > 0, tree.value_sum[cidx] / jnp.maximum(c_visits, 1), 0.0
+        )
+        q = jnp.where(
+            expanded, tree.reward[cidx] + cfg.discount * c_value, 0.0
+        )
+        u = (
+            cfg.cpuct
+            * tree.prior[node]
+            * jnp.sqrt(tree.visits[node].astype(jnp.float32))
+            / (1.0 + c_visits.astype(jnp.float32))
+        )
+        return jnp.where(tree.valid[node], q + u, -jnp.inf)
+
+    def _select_leaf(self, tree: Tree) -> tuple[jax.Array, jax.Array]:
+        """Descend by PUCT until an unexpanded edge / depth cap / terminal.
+
+        Returns (parent node index, action to expand).
+        """
+        max_depth = self.config.max_depth
+
+        def cond(carry):
+            _, _, _, stop = carry
+            return ~stop
+
+        def body(carry):
+            node, _, depth, _ = carry
+            action = jnp.argmax(self._puct_scores(tree, node))
+            child = tree.children[node, action]
+            stop = (
+                (child < 0)
+                | (depth + 1 >= max_depth)
+                | tree.terminal[node]
+            )
+            next_node = jnp.where(stop, node, child)
+            return next_node, action, depth + 1, stop
+
+        node, action, _, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+        )
+        return node, action
+
+    def _backup(
+        self, tree: Tree, leaf: jax.Array, leaf_value: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """Discounted backup from `leaf` to root; returns updated
+        (visits, value_sum)."""
+        discount = self.config.discount
+
+        def cond(carry):
+            node, *_ = carry
+            return node >= 0
+
+        def body(carry):
+            # Under vmap, lanes that already reached the root keep
+            # executing this body while other lanes walk; guard every
+            # update so a finished lane (node == -1) is a strict no-op
+            # instead of wrap-indexing the last slot.
+            node, g, visits, value_sum = carry
+            active = node >= 0
+            safe = jnp.maximum(node, 0)
+            visits = visits.at[safe].add(jnp.where(active, 1, 0))
+            value_sum = value_sum.at[safe].add(jnp.where(active, g, 0.0))
+            g = jnp.where(active, tree.reward[safe] + discount * g, g)
+            node = jnp.where(active, tree.parent[safe], node)
+            return node, g, visits, value_sum
+
+        _, _, visits, value_sum = jax.lax.while_loop(
+            cond, body, (leaf, leaf_value, tree.visits, tree.value_sum)
+        )
+        return visits, value_sum
+
+    # --- the search -------------------------------------------------------
+
+    def _init_tree(self, variables, root_states: EnvState, rng) -> Tree:
+        """Batched tree init: root eval + Dirichlet noise."""
+        cfg = self.config
+        batch = root_states.done.shape[0]
+        n, a = self.num_nodes, self.action_dim
+
+        priors, values, valid = self._evaluate(variables, root_states)
+        root_terminal = root_states.done
+        root_value = jnp.where(root_terminal, 0.0, values)
+
+        # Dirichlet root noise over valid actions (eps=0 or alpha=0 -> off).
+        if cfg.dirichlet_epsilon > 0 and cfg.dirichlet_alpha > 0:
+            gammas = jax.random.gamma(
+                rng, cfg.dirichlet_alpha, shape=(batch, a)
+            )
+            gammas = jnp.where(valid, gammas, 0.0)
+            noise = gammas / jnp.maximum(
+                gammas.sum(axis=-1, keepdims=True), 1e-9
+            )
+            priors = (1.0 - cfg.dirichlet_epsilon) * priors + (
+                cfg.dirichlet_epsilon
+            ) * noise
+            priors = jnp.where(valid, priors, 0.0)
+
+        def broadcast_to_nodes(x):
+            """Tile each game's root state across its N node slots."""
+            return jnp.broadcast_to(x[:, None], (batch, n) + x.shape[1:])
+
+        node_state = jax.tree_util.tree_map(broadcast_to_nodes, root_states)
+        zeros_na = jnp.zeros((batch, n, a), dtype=jnp.float32)
+        tree = Tree(
+            node_state=node_state,
+            visits=jnp.zeros((batch, n), dtype=jnp.int32).at[:, 0].set(1),
+            value_sum=jnp.zeros((batch, n), dtype=jnp.float32)
+            .at[:, 0]
+            .set(root_value),
+            prior=zeros_na.at[:, 0].set(priors),
+            valid=jnp.zeros((batch, n, a), dtype=bool).at[:, 0].set(valid),
+            children=jnp.full((batch, n, a), -1, dtype=jnp.int32),
+            parent=jnp.full((batch, n), -1, dtype=jnp.int32),
+            parent_action=jnp.full((batch, n), -1, dtype=jnp.int32),
+            reward=jnp.zeros((batch, n), dtype=jnp.float32),
+            terminal=jnp.zeros((batch, n), dtype=bool).at[:, 0].set(root_terminal),
+        )
+        return tree
+
+    def _search(
+        self, variables, root_states: EnvState, rng: jax.Array
+    ) -> SearchOutput:
+        """Run `max_simulations` batched simulations from `root_states`."""
+        cfg = self.config
+        batch = root_states.done.shape[0]
+        rng, noise_rng = jax.random.split(rng)
+        tree = self._init_tree(variables, root_states, noise_rng)
+        barange = jnp.arange(batch)
+
+        def sim_body(sim: jax.Array, tree: Tree) -> Tree:
+            # 1. Selection: vmapped descent over all B trees. The
+            # returned edge may already be expanded when the descent was
+            # stopped by the depth cap or a terminal node.
+            parents, actions = jax.vmap(self._select_leaf)(tree)
+            existing = tree.children[barange, parents, actions]  # (B,)
+            is_new = existing < 0
+
+            # 2. Expansion: one batched env.step over the selected edges.
+            # (The engine is deterministic given the node's PRNG state,
+            # so a revisited edge reproduces the existing child's state.)
+            parent_states = jax.tree_util.tree_map(
+                lambda x: x[barange, parents], tree.node_state
+            )
+            new_states, rewards, dones = jax.vmap(self.env.step)(
+                parent_states, actions
+            )
+
+            # 3. Evaluation: ONE batched network call for all B leaves.
+            priors, values, valid = self._evaluate(variables, new_states)
+            leaf_values = jnp.where(dones, 0.0, values)
+
+            # 4. Insert node `sim`. For revisited edges the existing
+            # child keeps the edge (and its accumulated statistics);
+            # slot `sim` is then an orphan with zero visits — a bounded
+            # waste that keeps every shape static.
+            node = sim  # scalar; same slot in every tree
+            target = jnp.where(is_new, node, existing)  # (B,) backup roots
+            ns = jax.tree_util.tree_map(
+                lambda buf, x: buf.at[:, node].set(x),
+                tree.node_state,
+                new_states,
+            )
+            tree = tree.replace(
+                node_state=ns,
+                prior=tree.prior.at[:, node].set(priors),
+                valid=tree.valid.at[:, node].set(valid),
+                children=tree.children.at[barange, parents, actions].set(
+                    target
+                ),
+                parent=tree.parent.at[:, node].set(
+                    jnp.where(is_new, parents, -1)
+                ),
+                parent_action=tree.parent_action.at[:, node].set(
+                    jnp.where(is_new, actions, -1)
+                ),
+                reward=tree.reward.at[:, node].set(rewards),
+                terminal=tree.terminal.at[:, node].set(dones),
+            )
+
+            # 5. Backup: vmapped discounted walk to the root, starting
+            # from the (possibly pre-existing) child of the chosen edge.
+            visits, value_sum = jax.vmap(self._backup)(
+                tree, target, leaf_values
+            )
+            return tree.replace(visits=visits, value_sum=value_sum)
+
+        tree = jax.lax.fori_loop(1, cfg.max_simulations + 1, sim_body, tree)
+
+        # Root visit counts: scatter child visits by parent_action for
+        # nodes whose parent is the root.
+        def root_counts(tree_i: Tree) -> jax.Array:
+            is_root_child = tree_i.parent == 0
+            counts = jnp.zeros(self.action_dim, dtype=jnp.float32)
+            return counts.at[
+                jnp.maximum(tree_i.parent_action, 0)
+            ].add(jnp.where(is_root_child, tree_i.visits, 0).astype(jnp.float32))
+
+        visit_counts = jax.vmap(root_counts)(tree)
+        root_value = tree.value_sum[:, 0] / jnp.maximum(
+            tree.visits[:, 0].astype(jnp.float32), 1.0
+        )
+        return SearchOutput(
+            visit_counts=visit_counts,
+            root_value=root_value,
+            root_prior=tree.prior[:, 0],
+            total_simulations=jnp.int32(cfg.max_simulations * batch),
+        )

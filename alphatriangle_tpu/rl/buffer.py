@@ -281,3 +281,7 @@ class ExperienceBuffer:
             self.tree.update_batch(np.arange(self.capacity), full)
             self.tree.data_pointer = self._pos
             self.tree.n_entries = n
+            # Reset the max-priority watermark too: update_batch only
+            # ratchets it up, and the pre-restore buffer's (possibly
+            # huge) max would otherwise dominate every post-restore add.
+            self.tree._max_priority_seen = float(max(1.0, full[:n].max(initial=0.0)))

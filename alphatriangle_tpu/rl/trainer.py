@@ -29,6 +29,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh
 
+from ..config.mesh_config import MeshConfig
 from ..config.train_config import TrainConfig
 from ..nn.network import NeuralNetwork
 from ..parallel.sharding import (
@@ -70,9 +71,15 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     if cfg.OPTIMIZER_TYPE == "AdamW":
         opt = optax.adamw(schedule, weight_decay=cfg.WEIGHT_DECAY)
     elif cfg.OPTIMIZER_TYPE == "Adam":
-        opt = optax.adam(schedule)
+        # torch-style coupled L2: decay folds into the gradient before
+        # the moment estimates (vs AdamW's decoupled decay).
+        opt = optax.chain(
+            optax.add_decayed_weights(cfg.WEIGHT_DECAY), optax.adam(schedule)
+        )
     elif cfg.OPTIMIZER_TYPE == "SGD":
-        opt = optax.sgd(schedule)
+        opt = optax.chain(
+            optax.add_decayed_weights(cfg.WEIGHT_DECAY), optax.sgd(schedule)
+        )
     else:  # pragma: no cover - pydantic Literal prevents this
         raise ValueError(f"Unknown optimizer {cfg.OPTIMIZER_TYPE}")
     if cfg.GRADIENT_CLIP_VALUE is not None:
@@ -130,10 +137,11 @@ class Trainer:
     ):
         self.nn = nn
         self.config = train_config
-        self.mesh = mesh or Mesh(
-            np.asarray(jax.devices()[:1]).reshape(1, 1), ("dp", "mdl")
-        )
-        self.dp_size = self.mesh.shape.get("dp", 1)
+        self.mesh = mesh or MeshConfig.single_device_mesh()
+        # Data-parallel axis = the mesh's first axis, whatever its name
+        # (MeshConfig.DP_AXIS is configurable).
+        self.dp_axis = self.mesh.axis_names[0]
+        self.dp_size = self.mesh.shape[self.dp_axis]
         self.model = nn.model
         mc = nn.model_config
         self.num_atoms = mc.NUM_VALUE_ATOMS
@@ -155,7 +163,7 @@ class Trainer:
 
         rep = replicated(self.mesh)
         state_shard = state_shardings(self.mesh, self.state)
-        bshard = batch_sharding(self.mesh)
+        bshard = batch_sharding(self.mesh, self.dp_axis)
         batch_shards: dict[str, Any] = {
             "grid": bshard,
             "other_features": bshard,
@@ -265,7 +273,7 @@ class Trainer:
             raise ValueError(
                 f"Batch size {n} not divisible by dp={self.dp_size}."
             )
-        device_batch = shard_batch(self.mesh, dict(batch))
+        device_batch = shard_batch(self.mesh, dict(batch), self.dp_axis)
         self.state, metrics, td = self._step_fn(self.state, device_batch)
         host_metrics = {k: float(v) for k, v in metrics.items()}
         host_metrics["learning_rate"] = self.get_current_lr()
@@ -293,12 +301,16 @@ class Trainer:
 
         Hands the wrapper a device-side copy: the live state buffers get
         donated by the next train step."""
-        self.nn.variables = jax.tree_util.tree_map(
-            jnp.array, self.get_variables()
+        self.nn.set_weights(
+            jax.tree_util.tree_map(jnp.array, self.get_variables())
         )
-        self.nn.weights_version += 1
         return self.nn.weights_version
 
     def set_state(self, state: TrainState) -> None:
-        """Install a restored TrainState (checkpoint resume path)."""
+        """Install a restored TrainState (checkpoint resume path).
+
+        Deep-copies: device_put is a no-op for already-replicated
+        arrays, and an aliased caller pytree would be deleted by the
+        next step's donation."""
+        state = jax.tree_util.tree_map(jnp.array, state)
         self.state = jax.device_put(state, replicated(self.mesh))

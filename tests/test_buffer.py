@@ -206,6 +206,11 @@ class TestPersistence:
         )
         out = full.sample(4, current_train_step=0)
         assert np.all(out["indices"] < 8)
+        # Max-priority watermark restored too: post-restore adds must
+        # not inherit the overwritten buffer's max (10.0).
+        assert full.tree.max_priority == pytest.approx(1.0)
+        full.add_dense(*make_dense(2, seed=5))
+        assert full.tree.tree[full.tree._cap2 + 8] == pytest.approx(1.0)
 
 
 class TestSelfPlayResult:

@@ -206,6 +206,33 @@ class TestMultiDevice:
         for a, b in zip(flat_s, flat_m, strict=True):
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
+    def test_custom_axis_names(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        mesh = MeshConfig(DP_SIZE=8, DP_AXIS="data", MDL_AXIS="model").build_mesh()
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config, mesh=mesh)
+        assert trainer.dp_size == 8
+        out = trainer.train_step(make_batch(16))
+        assert out is not None
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.train_step(make_batch(12))
+
+    def test_set_state_does_not_alias_caller(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        trainer.train_step(make_batch())
+        saved = trainer.state
+        trainer.set_state(saved)
+        trainer.train_step(make_batch(seed=1))
+        # The caller's snapshot must survive the donated step.
+        assert all(
+            np.isfinite(np.asarray(leaf)).all()
+            for leaf in jax.tree_util.tree_leaves(saved.params)
+        )
+
     def test_indivisible_batch_raises(
         self, tiny_model_config, tiny_env_config, tiny_train_config
     ):
