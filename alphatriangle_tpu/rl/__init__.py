@@ -1,6 +1,15 @@
 """RL core: replay buffer, trainer, self-play (reference `alphatriangle/rl/`)."""
 
 from .buffer import DenseSample, ExperienceBuffer
+from .self_play import SelfPlayEngine
+from .trainer import Trainer, TrainState
 from .types import SelfPlayResult
 
-__all__ = ["DenseSample", "ExperienceBuffer", "SelfPlayResult"]
+__all__ = [
+    "DenseSample",
+    "ExperienceBuffer",
+    "SelfPlayEngine",
+    "SelfPlayResult",
+    "TrainState",
+    "Trainer",
+]

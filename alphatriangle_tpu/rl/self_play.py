@@ -1,0 +1,261 @@
+"""Batched self-play: lockstep rollouts + vectorized n-step pipeline.
+
+Capability parity with the reference's `SelfPlayWorker.run_episode`
+(`alphatriangle/rl/self_play/worker.py:166-513`): MCTS per move,
+temperature-scheduled action selection, policy targets from visit
+counts, n-step returns with value bootstrap, trailing flush of
+unmatured experiences at episode end, staleness tagging.
+
+TPU-native redesign (SURVEY.md §7 step 9):
+- One `SelfPlayEngine` steps `B` games in lockstep; each move is a
+  handful of batched device dispatches (feature extract, MCTS search —
+  which itself batches every leaf eval across games onto the MXU —
+  action select, env step). There are no per-game actors and no weight
+  broadcast; the engine reads the `NeuralNetwork` wrapper's current
+  variables each search, so a learner `sync_to_network()` is visible on
+  the very next move (replaces `worker_manager.py:169-209`).
+- The n-step machinery is a **vectorized sliding window**: (B, n)
+  host arrays of pending experiences with incrementally-maintained
+  discounted partial returns, instead of per-game Python deques
+  (`worker.py:410-485`). An experience added at move t matures at move
+  t+n and is bootstrapped with that search's root value — the
+  MCTS-improved estimate of V(s_{t+n}), a strict upgrade over the
+  reference's raw network bootstrap (`worker.py:418`).
+- Games that finish flush their window without bootstrap (trailing
+  flush, `worker.py:466-485`) and are reset in place, so the batch
+  never shrinks and shapes stay static.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.mcts_config import MCTSConfig
+from ..config.train_config import TrainConfig
+from ..env.engine import TriangleEnv
+from ..features.core import FeatureExtractor
+from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
+from ..mcts.search import BatchedMCTS
+from ..nn.network import NeuralNetwork
+from .types import SelfPlayResult
+
+logger = logging.getLogger(__name__)
+
+
+class SelfPlayEngine:
+    """B games played in lockstep, emitting n-step experiences."""
+
+    def __init__(
+        self,
+        env: TriangleEnv,
+        extractor: FeatureExtractor,
+        net: NeuralNetwork,
+        mcts_config: MCTSConfig,
+        train_config: TrainConfig,
+        batch_size: int | None = None,
+        seed: int = 0,
+    ):
+        self.env = env
+        self.extractor = extractor
+        self.net = net
+        self.mcts = BatchedMCTS(
+            env, extractor, net.model, mcts_config, net.support
+        )
+        self.config = train_config
+        self.mcts_config = mcts_config
+        self.batch_size = batch_size or train_config.SELF_PLAY_BATCH_SIZE
+        self.n_step = train_config.N_STEP_RETURNS
+        self.gamma = train_config.GAMMA
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, reset_key = jax.random.split(self._rng)
+        self.states = env.reset_batch(
+            jax.random.split(reset_key, self.batch_size)
+        )
+
+        b, n = self.batch_size, self.n_step
+        c = extractor.model_config.GRID_INPUT_CHANNELS
+        f = extractor.other_dim
+        a = env.action_dim
+        self._grid_shape = (c, env.rows, env.cols)
+        self._pend_grid = np.zeros((b, n, c, env.rows, env.cols), np.float32)
+        self._pend_other = np.zeros((b, n, f), np.float32)
+        self._pend_policy = np.zeros((b, n, a), np.float32)
+        self._pend_return = np.zeros((b, n), np.float32)
+        self._pend_discount = np.ones((b, n), np.float32)
+        self._pend_active = np.zeros((b, n), bool)
+
+        self._move_index = 0  # global move counter (window slot = t % n)
+        # Oldest weights version contributing to the current harvest
+        # window (conservative staleness tag; a mid-window sync must not
+        # relabel earlier experiences as fresh). None = window not
+        # started; resolved at the first move of each window.
+        self._min_weights_version: int | None = None
+        self._out: list[tuple[np.ndarray, ...]] = []
+        self._episode_scores: list[float] = []
+        self._episode_lengths: list[int] = []
+        self._episodes_played = 0
+        self._total_simulations = 0
+
+    def _next_key(self) -> jax.Array:
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def _temperatures(self, step_counts: np.ndarray) -> np.ndarray:
+        """Per-game move-indexed temperature (reference `worker.py:311-332`)."""
+        cfg = self.config
+        frac = np.minimum(
+            step_counts.astype(np.float32) / cfg.TEMPERATURE_ANNEAL_MOVES, 1.0
+        )
+        return cfg.TEMPERATURE_INITIAL + frac * (
+            cfg.TEMPERATURE_FINAL - cfg.TEMPERATURE_INITIAL
+        )
+
+    def _emit(self, mask: np.ndarray, slot_returns: np.ndarray, slots: slice | int):
+        """Queue pending experiences `[mask, slots]` with final returns."""
+        if not mask.any():
+            return
+        self._out.append(
+            (
+                self._pend_grid[mask, slots].reshape(-1, *self._grid_shape),
+                self._pend_other[mask, slots].reshape(
+                    -1, self._pend_other.shape[-1]
+                ),
+                self._pend_policy[mask, slots].reshape(
+                    -1, self._pend_policy.shape[-1]
+                ),
+                np.asarray(slot_returns[mask], np.float32).reshape(-1),
+            )
+        )
+
+    def play_move(self) -> None:
+        """Advance every game by one move."""
+        t = self._move_index
+        w = t % self.n_step
+        states = self.states
+        self._min_weights_version = (
+            self.net.weights_version
+            if self._min_weights_version is None
+            else min(self._min_weights_version, self.net.weights_version)
+        )
+
+        # 1-2. Features for replay + batched search (one MXU leaf batch
+        # per simulation across all B games).
+        grids, others = self.extractor.extract_batch(states)
+        out = self.mcts.search(self.net.variables, states, self._next_key())
+        counts = np.asarray(out.visit_counts)
+        root_value = np.asarray(out.root_value)
+        self._total_simulations += int(out.total_simulations)
+
+        valid = np.asarray(self.env.valid_mask_batch(states))
+        policy = np.asarray(
+            policy_target_from_visits(out.visit_counts, jnp.asarray(valid))
+        )
+
+        # 3. Mature the slot added n moves ago: bootstrap with this
+        # search's root value (the MCTS estimate of V(s_{t}) = V(s_{t-n+n})).
+        matured = self._pend_active[:, w].copy()
+        if matured.any():
+            boot = (
+                self._pend_return[:, w]
+                + self._pend_discount[:, w] * root_value
+            )
+            self._emit(matured, boot, w)
+            self._pend_active[:, w] = False
+
+        # 4. Select actions (temperature by each game's own move count)
+        # and step all games in one dispatch.
+        temps = self._temperatures(np.asarray(states.step_count))
+        actions = select_action_from_visits(
+            out.visit_counts, jnp.asarray(temps), self._next_key()
+        )
+        actions = jnp.maximum(actions, 0)  # sentinel guard (no-visit rows)
+        new_states, rewards, dones = self.env.step_batch(states, actions)
+        rewards_np = np.asarray(rewards)
+        dones_np = np.asarray(dones)
+
+        # 5. Add this move's experience into window slot w.
+        self._pend_grid[:, w] = np.asarray(grids)
+        self._pend_other[:, w] = np.asarray(others)
+        self._pend_policy[:, w] = policy
+        self._pend_return[:, w] = 0.0
+        self._pend_discount[:, w] = 1.0
+        self._pend_active[:, w] = True
+
+        # 6. Fold this move's reward into every pending experience.
+        self._pend_return += np.where(
+            self._pend_active, self._pend_discount * rewards_np[:, None], 0.0
+        )
+        self._pend_discount = np.where(
+            self._pend_active, self._pend_discount * self.gamma, 1.0
+        )
+
+        # 7. Trailing flush for finished (or move-capped) games: emit all
+        # pending slots without bootstrap (`worker.py:466-485`).
+        step_counts = np.asarray(new_states.step_count)
+        truncated = (~dones_np) & (step_counts >= self.config.MAX_EPISODE_MOVES)
+        ending = dones_np | truncated
+        if ending.any():
+            flush = self._pend_active & ending[:, None]
+            self._emit(flush, self._pend_return.copy(), slice(None))
+            self._pend_active[ending] = False
+            scores = np.asarray(new_states.score)
+            for b in np.flatnonzero(ending):
+                self._episode_scores.append(float(scores[b]))
+                self._episode_lengths.append(int(step_counts[b]))
+            self._episodes_played += int(ending.sum())
+            # Force-terminate truncated games so reset picks them up.
+            if truncated.any():
+                new_states = new_states.replace(
+                    done=jnp.asarray(dones_np | truncated)
+                )
+
+        # 8. Reset finished games in place; batch shape never changes.
+        self.states = self.env.reset_where_done_jit(
+            new_states, self._next_key()
+        )
+        self._move_index += 1
+
+    def play_moves(self, num_moves: int) -> SelfPlayResult:
+        """Advance all games `num_moves` moves and harvest experiences."""
+        for _ in range(num_moves):
+            self.play_move()
+        return self.harvest()
+
+    def harvest(self) -> SelfPlayResult:
+        """Collect emitted experiences + episode stats since last call."""
+        if self._out:
+            grids = np.concatenate([o[0] for o in self._out])
+            others = np.concatenate([o[1] for o in self._out])
+            policies = np.concatenate([o[2] for o in self._out])
+            values = np.concatenate([o[3] for o in self._out])
+        else:
+            c, h, w = self._grid_shape
+            grids = np.zeros((0, c, h, w), np.float32)
+            others = np.zeros((0, self._pend_other.shape[-1]), np.float32)
+            policies = np.zeros((0, self._pend_policy.shape[-1]), np.float32)
+            values = np.zeros((0,), np.float32)
+        result = SelfPlayResult(
+            grid=grids,
+            other_features=others,
+            policy_target=policies,
+            value_target=values,
+            episode_scores=self._episode_scores,
+            episode_lengths=self._episode_lengths,
+            num_episodes=self._episodes_played,
+            total_simulations=self._total_simulations,
+            trainer_step_at_episode_start=(
+                self._min_weights_version
+                if self._min_weights_version is not None
+                else self.net.weights_version
+            ),
+        )
+        self._out = []
+        self._episode_scores = []
+        self._episode_lengths = []
+        self._episodes_played = 0
+        self._total_simulations = 0
+        self._min_weights_version = None
+        return result

@@ -138,9 +138,12 @@ class Trainer:
         self.nn = nn
         self.config = train_config
         self.mesh = mesh or MeshConfig.single_device_mesh()
-        # Data-parallel axis = the mesh's first axis, whatever its name
-        # (MeshConfig.DP_AXIS is configurable).
-        self.dp_axis = self.mesh.axis_names[0]
+        # Data-parallel axis: the conventional name "dp" wins if present
+        # (meshes may order axes arbitrarily); otherwise the first axis
+        # (MeshConfig.DP_AXIS is configurable and always comes first).
+        self.dp_axis = (
+            "dp" if "dp" in self.mesh.axis_names else self.mesh.axis_names[0]
+        )
         self.dp_size = self.mesh.shape[self.dp_axis]
         self.model = nn.model
         mc = nn.model_config
