@@ -42,6 +42,10 @@ class TrainConfig(BaseModel):
     WORKER_UPDATE_FREQ_STEPS: int = Field(default=10, ge=1)
     # Hard cap on moves per episode (safety net for jitted rollouts).
     MAX_EPISODE_MOVES: int = Field(default=1000, ge=1)
+    # Learner steps per rollout chunk. None = auto: match the production
+    # rate (experiences harvested / BATCH_SIZE), the synchronous
+    # equivalent of the reference's free-running async learner.
+    LEARNER_STEPS_PER_ROLLOUT: int | None = Field(default=None, ge=1)
 
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)

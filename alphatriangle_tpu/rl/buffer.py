@@ -176,7 +176,8 @@ class ExperienceBuffer:
     def is_ready(self) -> bool:
         return self._size >= self.min_size_to_train
 
-    def _beta(self, train_step: int) -> float:
+    def beta(self, train_step: int) -> float:
+        """Annealed PER importance-sampling exponent at `train_step`."""
         frac = min(1.0, max(0.0, train_step / self.beta_anneal_steps))
         return self.beta_initial + frac * (self.beta_final - self.beta_initial)
 
@@ -201,7 +202,7 @@ class ExperienceBuffer:
             slots, priorities = self.tree.sample_batch(batch_size, self._rng)
             total = self.tree.total_priority
             probs = np.maximum(priorities, 1e-12) / max(total, 1e-12)
-            beta = self._beta(current_train_step)
+            beta = self.beta(current_train_step)
             weights = (self._size * probs) ** (-beta)
             weights = (weights / weights.max()).astype(np.float32)
         else:

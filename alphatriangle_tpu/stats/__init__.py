@@ -1,0 +1,21 @@
+"""Stats + persistence (trieye equivalent, SURVEY.md §2b).
+
+The reference delegates metrics aggregation and checkpoint/resume to a
+detached Ray actor (`trieye`). Here the same responsibilities are an
+in-process `StatsCollector` (lock-guarded event sink -> TensorBoard on
+`process_and_log` ticks) and an Orbax-backed `CheckpointManager`
+(jax-pytree train state + dense buffer spill + auto-resume) — no actor
+runtime required, and checkpoints are standard Orbax trees any JAX tool
+can read.
+"""
+
+from .collector import StatsCollector
+from .events import RawMetricEvent
+from .persistence import CheckpointManager, LoadedTrainingState
+
+__all__ = [
+    "CheckpointManager",
+    "LoadedTrainingState",
+    "RawMetricEvent",
+    "StatsCollector",
+]

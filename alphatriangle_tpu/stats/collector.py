@@ -1,0 +1,112 @@
+"""In-process metric aggregation -> TensorBoard.
+
+Capability parity with the trieye actor surface the reference calls
+(`log_event` / `log_batch_events` / `process_and_log` /
+`force_process_and_log`, SURVEY.md §2b): subsystems fire events at any
+rate; aggregation + IO happen only on `process_and_log` ticks.
+
+Design: the reference needed a Ray actor because producers lived in
+other processes. Here producers share the learner process (self-play is
+device-batched), so the "actor" collapses to a lock-guarded buffer —
+`log_event` is an O(1) append off the device path, and TensorBoard
+writes occur on the tick, never blocking a dispatch. MLflow is absent
+from this environment; the writer degrades to TensorBoard-only
+(reference logs to both, `README.md:63-79`).
+"""
+
+import logging
+import threading
+from collections import defaultdict
+from pathlib import Path
+
+import numpy as np
+
+from ..config.persistence_config import PersistenceConfig
+from .events import RawMetricEvent
+
+logger = logging.getLogger(__name__)
+
+try:  # tensorboardX is baked into the image; guard anyway.
+    from tensorboardX import SummaryWriter
+except Exception:  # pragma: no cover
+    SummaryWriter = None
+
+
+class StatsCollector:
+    """Aggregates raw metric events; writes means per tick to TensorBoard."""
+
+    def __init__(
+        self,
+        persistence: PersistenceConfig | None = None,
+        use_tensorboard: bool = True,
+        log_dir: str | Path | None = None,
+    ):
+        self._lock = threading.Lock()
+        self._pending: dict[str, list[tuple[int, float]]] = defaultdict(list)
+        self._history: dict[str, list[tuple[int, float]]] = defaultdict(list)
+        self._writer = None
+        if use_tensorboard and SummaryWriter is not None:
+            tb_dir = Path(log_dir) if log_dir else (
+                persistence.get_tensorboard_dir() if persistence else None
+            )
+            if tb_dir is not None:
+                tb_dir.mkdir(parents=True, exist_ok=True)
+                self._writer = SummaryWriter(str(tb_dir))
+
+    # --- ingestion (cheap, any thread) ------------------------------------
+
+    def log_event(self, event: RawMetricEvent) -> None:
+        if not np.isfinite(event.value):
+            logger.debug("Dropping non-finite metric %s", event.name)
+            return
+        with self._lock:
+            self._pending[event.name].append((event.global_step, event.value))
+
+    def log_batch_events(self, events: list[RawMetricEvent]) -> None:
+        for e in events:
+            self.log_event(e)
+
+    def log_scalar(self, name: str, value: float, step: int = 0) -> None:
+        """Convenience: log a bare scalar without building an event."""
+        self.log_event(RawMetricEvent(name=name, value=value, global_step=step))
+
+    # --- aggregation ticks ------------------------------------------------
+
+    def process_and_log(self, global_step: int) -> dict[str, float]:
+        """Flush pending events: mean per metric, written at `global_step`.
+
+        Returns the aggregated means (name -> mean) for callers/tests.
+        """
+        with self._lock:
+            pending, self._pending = self._pending, defaultdict(list)
+        means: dict[str, float] = {}
+        for name, obs in pending.items():
+            if not obs:
+                continue
+            mean = float(np.mean([v for _, v in obs]))
+            means[name] = mean
+            self._history[name].append((global_step, mean))
+            if self._writer is not None:
+                self._writer.add_scalar(name, mean, global_step)
+        if self._writer is not None and means:
+            self._writer.flush()
+        return means
+
+    def force_process_and_log(self, global_step: int) -> dict[str, float]:
+        """Final flush (reference `runner.py:288` semantics)."""
+        return self.process_and_log(global_step)
+
+    # --- introspection ----------------------------------------------------
+
+    def get_series(self, name: str) -> list[tuple[int, float]]:
+        """Aggregated (step, mean) history of one metric."""
+        return list(self._history.get(name, []))
+
+    def latest(self, name: str) -> float | None:
+        series = self._history.get(name)
+        return series[-1][1] if series else None
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -1,0 +1,17 @@
+"""Metric event type (reference: trieye `RawMetricEvent`, observed at
+`alphatriangle/rl/self_play/worker.py:147-153`)."""
+
+import time
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+
+class RawMetricEvent(BaseModel):
+    """One raw metric observation, aggregated by the collector."""
+
+    name: str
+    value: float
+    global_step: int = 0
+    timestamp: float = Field(default_factory=time.time)
+    context: dict[str, Any] = {}

@@ -1,0 +1,253 @@
+"""Checkpoint/resume + buffer spill (trieye persistence equivalent).
+
+Parity surface per the reference call sites (`training/runner.py:28-163`,
+`training/loop.py:173-211`, SURVEY.md §3.4): periodic checkpoint of
+model/optimizer state + counters, optional replay-buffer spill,
+`load_initial_state`-style restore, and auto-resume from the latest run.
+
+TPU-native shape: the learner state is a jax pytree (`TrainState`), so
+checkpoints are **Orbax** trees — standard, async-written, readable by
+any JAX tool — instead of cloudpickled torch state dicts. The dense SoA
+replay buffer spills to a compressed `.npz` (fixed-shape arrays, no
+pickle). Improvement over the reference: PER priorities are persisted
+and restored (the reference resets them to max on resume,
+`runner.py:87-91`).
+"""
+
+import json
+import logging
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ..config.persistence_config import PersistenceConfig
+from ..rl.buffer import ExperienceBuffer
+
+logger = logging.getLogger(__name__)
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+
+@dataclass
+class LoadedTrainingState:
+    """Everything a resumed run needs (reference `LoadedTrainingState`)."""
+
+    train_state: Any | None = None
+    buffer_loaded: bool = False
+    counters: dict[str, Any] = field(default_factory=dict)
+    run_name: str | None = None
+    global_step: int = 0
+
+
+class CheckpointManager:
+    """Owns one run's checkpoint/buffer directories."""
+
+    def __init__(self, persistence: PersistenceConfig):
+        self.config = persistence
+        persistence.create_run_dirs()
+        self._ckpt_dir = persistence.get_checkpoint_dir().resolve()
+        self._buffer_dir = persistence.get_buffer_dir().resolve()
+        self._ckptr = ocp.StandardCheckpointer()
+
+    # --- save -------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        train_state: Any,
+        counters: dict[str, Any] | None = None,
+    ) -> Path:
+        """Checkpoint `train_state` (async) + counters; buffer spills go
+        through `save_buffer`. Returns the checkpoint path."""
+        path = self._ckpt_dir / f"step_{step:08d}"
+        if path.exists():  # overwrite-safe for forced final saves
+            import shutil
+
+            # An async save of this step may still be in flight; let it
+            # land before removing, or the writer races the rmtree.
+            self._ckptr.wait_until_finished()
+            shutil.rmtree(path, ignore_errors=True)
+        self._ckptr.save(path, train_state)
+        meta = {"global_step": step, **(counters or {})}
+        (self._ckpt_dir / f"step_{step:08d}.meta.json").write_text(
+            json.dumps(meta, indent=2)
+        )
+        logger.info("Checkpoint saved at step %d -> %s", step, path)
+        return path
+
+    def save_buffer(self, step: int, buffer: ExperienceBuffer) -> Path | None:
+        state = buffer.get_state()
+        if state["storage"] is None:
+            return None
+        path = self._buffer_dir / f"buffer_{step:08d}.npz"
+        arrays = {f"storage_{k}": v for k, v in state["storage"].items()}
+        if state["priorities"] is not None:
+            arrays["priorities"] = state["priorities"]
+        np.savez_compressed(
+            path, pos=state["pos"], size=state["size"], **arrays
+        )
+        logger.info("Buffer spilled (%d experiences) -> %s", state["size"], path)
+        return path
+
+    def save_configs(self, configs: dict[str, Any]) -> None:
+        """Dump config models to the run dir (reference README.md:79)."""
+        out = {
+            k: (v.model_dump() if hasattr(v, "model_dump") else v)
+            for k, v in configs.items()
+        }
+        (self.config.get_run_base_dir() / "configs.json").write_text(
+            json.dumps(out, indent=2, default=str)
+        )
+
+    def wait_until_finished(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait_until_finished()
+        self._ckptr.close()
+
+    # --- load -------------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        steps = [
+            int(m.group(1))
+            for p in self._ckpt_dir.iterdir()
+            if p.is_dir() and (m := _STEP_DIR_RE.match(p.name))
+        ] if self._ckpt_dir.exists() else []
+        return max(steps) if steps else None
+
+    def restore(
+        self,
+        template_state: Any,
+        step: int | None = None,
+        buffer: ExperienceBuffer | None = None,
+    ) -> LoadedTrainingState:
+        """Restore the checkpoint at `step` (default: latest).
+
+        `template_state` supplies the pytree structure/shapes (the
+        freshly-initialized `TrainState`). Restores the buffer in place
+        when a spill at <= step exists and `buffer` is given.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return LoadedTrainingState(run_name=self.config.RUN_NAME)
+        path = self._ckpt_dir / f"step_{step:08d}"
+        restored = self._ckptr.restore(path, target=template_state)
+        meta_path = self._ckpt_dir / f"step_{step:08d}.meta.json"
+        counters: dict[str, Any] = {}
+        if meta_path.exists():
+            counters = json.loads(meta_path.read_text())
+        buffer_loaded = False
+        if buffer is not None:
+            buffer_loaded = self.restore_buffer(buffer, max_step=step)
+        logger.info(
+            "Restored checkpoint step %d from %s (buffer=%s)",
+            step,
+            path,
+            buffer_loaded,
+        )
+        return LoadedTrainingState(
+            train_state=restored,
+            buffer_loaded=buffer_loaded,
+            counters=counters,
+            run_name=self.config.RUN_NAME,
+            global_step=int(counters.get("global_step", step)),
+        )
+
+    def restore_path(
+        self, path: str | Path, template_state: Any
+    ) -> LoadedTrainingState:
+        """Restore from an explicit checkpoint step directory
+        (`TrainConfig.LOAD_CHECKPOINT_PATH`, reference `runner.py:36-38`)."""
+        path = Path(path).resolve()
+        if not path.is_dir():
+            raise FileNotFoundError(f"No checkpoint directory at {path}")
+        restored = self._ckptr.restore(path, target=template_state)
+        counters: dict[str, Any] = {}
+        meta_path = path.parent / f"{path.name}.meta.json"
+        if meta_path.exists():
+            counters = json.loads(meta_path.read_text())
+        m = _STEP_DIR_RE.match(path.name)
+        step = int(counters.get("global_step", int(m.group(1)) if m else 0))
+        return LoadedTrainingState(
+            train_state=restored,
+            counters=counters,
+            run_name=self.config.RUN_NAME,
+            global_step=step,
+        )
+
+    @staticmethod
+    def restore_buffer_path(buffer: ExperienceBuffer, path: str | Path) -> bool:
+        """Load an explicit buffer spill (`TrainConfig.LOAD_BUFFER_PATH`)."""
+        path = Path(path)
+        if not path.is_file():
+            raise FileNotFoundError(f"No buffer spill at {path}")
+        CheckpointManager._load_spill_into(buffer, path)
+        return True
+
+    def restore_buffer(
+        self, buffer: ExperienceBuffer, max_step: int | None = None
+    ) -> bool:
+        """Load the newest buffer spill (optionally <= max_step) in place."""
+        if not self._buffer_dir.exists():
+            return False
+        spills = sorted(self._buffer_dir.glob("buffer_*.npz"))
+        if max_step is not None:
+            spills = [
+                s
+                for s in spills
+                if int(s.stem.split("_")[1]) <= max_step
+            ]
+        if not spills:
+            return False
+        self._load_spill_into(buffer, spills[-1])
+        return True
+
+    @staticmethod
+    def _load_spill_into(buffer: ExperienceBuffer, path: Path) -> None:
+        with np.load(path) as data:
+            storage = {
+                k[len("storage_"):]: data[k]
+                for k in data.files
+                if k.startswith("storage_")
+            }
+            state = {
+                "pos": int(data["pos"]),
+                "size": int(data["size"]),
+                "storage": storage,
+                "priorities": (
+                    data["priorities"] if "priorities" in data.files else None
+                ),
+            }
+        buffer.set_state(state)
+
+    # --- auto-resume ------------------------------------------------------
+
+    @staticmethod
+    def find_latest_run(persistence: PersistenceConfig) -> str | None:
+        """Newest run (by checkpoint mtime) with at least one checkpoint
+        (reference auto-resume, `README.md:23`, `train_config.py:26`)."""
+        runs_root = persistence.get_runs_root_dir()
+        if not runs_root.exists():
+            return None
+        candidates: list[tuple[float, str]] = []
+        for run_dir in runs_root.iterdir():
+            ckpts = run_dir / "checkpoints"
+            if not ckpts.is_dir():
+                continue
+            steps = [
+                p for p in ckpts.iterdir()
+                if p.is_dir() and _STEP_DIR_RE.match(p.name)
+            ]
+            if steps:
+                candidates.append(
+                    (max(p.stat().st_mtime for p in steps), run_dir.name)
+                )
+        if not candidates:
+            return None
+        return max(candidates)[1]

@@ -1,0 +1,23 @@
+"""Training orchestration (reference `alphatriangle/training/`).
+
+The reference's orchestration is Ray plumbing: actor fan-out, object
+store weight broadcasts, `ray.wait` harvesting (`loop.py:298-416`,
+`worker_manager.py:39-209`). Device-batched self-play removes all of it:
+one process alternates rollout chunks with learner steps, and the only
+"broadcast" is a device-buffer swap. What remains — cadences, stop
+conditions, checkpoint triggers, metric events, exit codes — is
+capability parity.
+"""
+
+from .components import TrainingComponents
+from .loop import LoopStatus, TrainingLoop
+from .runner import run_training
+from .setup import setup_training_components
+
+__all__ = [
+    "LoopStatus",
+    "TrainingComponents",
+    "TrainingLoop",
+    "run_training",
+    "setup_training_components",
+]

@@ -1,0 +1,42 @@
+"""Component bundle (reference `training/components.py:22-36`)."""
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..config.env_config import EnvConfig
+from ..config.mcts_config import MCTSConfig
+from ..config.mesh_config import MeshConfig
+from ..config.model_config import ModelConfig
+from ..config.persistence_config import PersistenceConfig
+from ..config.train_config import TrainConfig
+from ..env.engine import TriangleEnv
+from ..features.core import FeatureExtractor
+from ..nn.network import NeuralNetwork
+from ..rl.buffer import ExperienceBuffer
+from ..rl.self_play import SelfPlayEngine
+from ..rl.trainer import Trainer
+from ..stats.collector import StatsCollector
+from ..stats.persistence import CheckpointManager
+
+
+@dataclass
+class TrainingComponents:
+    """Everything a training run needs, built by `setup_training_components`."""
+
+    env: TriangleEnv
+    extractor: FeatureExtractor
+    net: NeuralNetwork
+    buffer: ExperienceBuffer
+    trainer: Trainer
+    self_play: SelfPlayEngine
+    stats: StatsCollector
+    checkpoints: CheckpointManager
+
+    env_config: EnvConfig
+    model_config: ModelConfig
+    train_config: TrainConfig
+    mcts_config: MCTSConfig
+    mesh_config: MeshConfig
+    persistence_config: PersistenceConfig
+
+    extra: dict[str, Any] = field(default_factory=dict)

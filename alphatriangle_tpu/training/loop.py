@@ -1,0 +1,274 @@
+"""The training loop (reference `training/loop.py:23-416`).
+
+Synchronous producer/consumer over device-batched self-play: each
+iteration plays a rollout chunk (`ROLLOUT_CHUNK_MOVES` moves of all
+`SELF_PLAY_BATCH_SIZE` games), folds the harvest into the replay
+buffer, then runs learner steps — auto-matched to the production rate
+unless `LEARNER_STEPS_PER_ROLLOUT` pins it. Cadences are parity knobs:
+weight sync every `WORKER_UPDATE_FREQ_STEPS` learner steps
+(`loop.py:271-287`), checkpoint every `CHECKPOINT_SAVE_FREQ_STEPS`
+(`loop.py:333-339`), buffer spill every `BUFFER_SAVE_FREQ_STEPS`
+(`loop.py:341-349`), metric tick per iteration (`loop.py:390-391`).
+"""
+
+import logging
+import threading
+import time
+from enum import Enum
+
+import numpy as np
+
+from ..stats.events import RawMetricEvent
+from ..utils.helpers import format_eta
+from .components import TrainingComponents
+
+logger = logging.getLogger(__name__)
+
+
+class LoopStatus(str, Enum):
+    COMPLETED = "completed"
+    STOPPED = "stopped"
+    ERROR = "error"
+
+
+class TrainingLoop:
+    """Drives produce -> buffer -> train -> sync -> persist."""
+
+    def __init__(self, components: TrainingComponents):
+        self.c = components
+        self.cfg = components.train_config
+        self.stop_event = threading.Event()
+
+        self.global_step = 0
+        self.episodes_played = 0
+        self.total_simulations = 0
+        self.weight_updates = 0
+        self._last_saved_step: int | None = None
+        self._last_progress_time = time.monotonic()
+        self._last_progress_step = 0
+
+    # --- resume -----------------------------------------------------------
+
+    def set_initial_state(
+        self, global_step: int, episodes_played: int, total_simulations: int
+    ) -> None:
+        """Install resumed counters (reference `loop.py:72-86`)."""
+        self.global_step = global_step
+        self.episodes_played = episodes_played
+        self.total_simulations = total_simulations
+        self._last_progress_step = global_step
+
+    # --- iteration pieces -------------------------------------------------
+
+    def _process_rollout(self) -> int:
+        """One rollout chunk -> buffer. Returns experiences added."""
+        c = self.c
+        result = c.self_play.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
+        c.buffer.add_dense(
+            result.grid,
+            result.other_features,
+            result.policy_target,
+            result.value_target,
+        )
+        self.episodes_played += result.num_episodes
+        self.total_simulations += result.total_simulations
+        step = self.global_step
+        events = [
+            RawMetricEvent(
+                name="Buffer/Size", value=len(c.buffer), global_step=step
+            ),
+            RawMetricEvent(
+                name="SelfPlay/Experiences_Per_Chunk",
+                value=result.num_experiences,
+                global_step=step,
+            ),
+        ]
+        if result.num_episodes:
+            events += [
+                RawMetricEvent(
+                    name="SelfPlay/Episode_Score",
+                    value=float(np.mean(result.episode_scores)),
+                    global_step=step,
+                ),
+                RawMetricEvent(
+                    name="SelfPlay/Episode_Length",
+                    value=float(np.mean(result.episode_lengths)),
+                    global_step=step,
+                ),
+                RawMetricEvent(
+                    name="Progress/Episodes_Played",
+                    value=self.episodes_played,
+                    global_step=step,
+                ),
+                RawMetricEvent(
+                    name="SelfPlay/Staleness_Steps",
+                    value=c.net.weights_version
+                    - result.trainer_step_at_episode_start,
+                    global_step=step,
+                ),
+            ]
+        c.stats.log_batch_events(events)
+        return result.num_experiences
+
+    def _run_training_step(self) -> bool:
+        """One sample -> train -> priority-update -> maybe sync cycle.
+
+        Returns False when the buffer could not produce a batch
+        (reference `loop.py:213-296`).
+        """
+        c = self.c
+        sample = c.buffer.sample(
+            self.cfg.BATCH_SIZE, current_train_step=self.global_step
+        )
+        if sample is None:
+            return False
+        out = c.trainer.train_step(sample["batch"])
+        if out is None:
+            return False
+        metrics, td_errors = out
+        c.buffer.update_priorities(sample["indices"], td_errors)
+        self.global_step = c.trainer.global_step
+
+        step = self.global_step
+        events = [
+            RawMetricEvent(
+                name=f"Loss/{key}", value=val, global_step=step
+            )
+            for key, val in metrics.items()
+            if key.endswith("loss")
+        ]
+        events += [
+            RawMetricEvent(
+                name="LearningRate",
+                value=metrics["learning_rate"],
+                global_step=step,
+            ),
+            RawMetricEvent(
+                name="Loss/Entropy", value=metrics["entropy"], global_step=step
+            ),
+            RawMetricEvent(
+                name="Loss/Grad_Norm",
+                value=metrics["grad_norm"],
+                global_step=step,
+            ),
+        ]
+        if self.cfg.USE_PER:
+            events.append(
+                RawMetricEvent(
+                    name="PER/Beta",
+                    value=c.buffer.beta(step),
+                    global_step=step,
+                )
+            )
+        c.stats.log_batch_events(events)
+
+        if step % self.cfg.WORKER_UPDATE_FREQ_STEPS == 0:
+            c.trainer.sync_to_network()
+            self.weight_updates += 1
+            c.stats.log_scalar(
+                "Progress/Weight_Updates_Total", self.weight_updates, step
+            )
+        return True
+
+    def _maybe_checkpoint(self, force: bool = False) -> None:
+        c = self.c
+        step = self.global_step
+        due = force or (
+            step > 0 and step % self.cfg.CHECKPOINT_SAVE_FREQ_STEPS == 0
+        )
+        if due and self._last_saved_step != step:
+            self._last_saved_step = step
+            c.checkpoints.save(
+                step,
+                c.trainer.state,
+                counters={
+                    "episodes_played": self.episodes_played,
+                    "total_simulations": self.total_simulations,
+                    "weight_updates": self.weight_updates,
+                },
+            )
+        save_buffer = c.persistence_config.SAVE_BUFFER and (
+            force
+            or (
+                step > 0
+                and step % c.persistence_config.BUFFER_SAVE_FREQ_STEPS == 0
+            )
+        )
+        if save_buffer:
+            c.checkpoints.save_buffer(step, c.buffer)
+
+    def _log_progress(self) -> None:
+        now = time.monotonic()
+        elapsed = now - self._last_progress_time
+        if elapsed < 10.0:
+            return
+        steps = self.global_step - self._last_progress_step
+        rate = steps / elapsed if elapsed > 0 else 0.0
+        max_steps = self.cfg.MAX_TRAINING_STEPS
+        eta = (
+            format_eta((max_steps - self.global_step) / rate)
+            if rate > 0 and max_steps
+            else "?"
+        )
+        logger.info(
+            "step %d/%s | %.2f steps/s | buffer %d | episodes %d | ETA %s",
+            self.global_step,
+            max_steps,
+            rate,
+            len(self.c.buffer),
+            self.episodes_played,
+            eta,
+        )
+        self._last_progress_time = now
+        self._last_progress_step = self.global_step
+
+    # --- main loop --------------------------------------------------------
+
+    def run(self) -> LoopStatus:
+        """Run until MAX_TRAINING_STEPS / stop / error
+        (reference `loop.py:298-416`)."""
+        cfg = self.cfg
+        status = LoopStatus.COMPLETED
+        try:
+            while not self.stop_event.is_set():
+                if (
+                    cfg.MAX_TRAINING_STEPS is not None
+                    and self.global_step >= cfg.MAX_TRAINING_STEPS
+                ):
+                    logger.info(
+                        "Reached MAX_TRAINING_STEPS=%d.", cfg.MAX_TRAINING_STEPS
+                    )
+                    break
+                added = self._process_rollout()
+                n_steps = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
+                    1, round(added / cfg.BATCH_SIZE)
+                )
+                for _ in range(n_steps):
+                    if (
+                        cfg.MAX_TRAINING_STEPS is not None
+                        and self.global_step >= cfg.MAX_TRAINING_STEPS
+                    ):
+                        break
+                    if not self._run_training_step():
+                        break
+                    # Cadence check per learner step: iterations can run
+                    # several steps, which would hop over multiples of
+                    # CHECKPOINT_SAVE_FREQ_STEPS.
+                    self._maybe_checkpoint()
+                self.c.stats.process_and_log(self.global_step)
+                self._log_progress()
+        except KeyboardInterrupt:
+            logger.warning("Interrupted; saving final state.")
+            status = LoopStatus.STOPPED
+        except Exception:
+            logger.exception("Training loop error.")
+            status = LoopStatus.ERROR
+        finally:
+            try:
+                self._maybe_checkpoint(force=True)
+                self.c.checkpoints.wait_until_finished()
+                self.c.stats.force_process_and_log(self.global_step)
+            except Exception:
+                logger.exception("Final save failed.")
+                status = LoopStatus.ERROR
+        return status

@@ -1,0 +1,130 @@
+"""Top-level `run_training` (reference `training/runner.py:166-307`).
+
+Responsibilities kept at parity: logging setup, auto-resume resolution,
+component setup, initial-state load (train state + buffer + counters),
+loop run, final save, exit-code mapping. Dropped by design: Ray init/
+shutdown, actor kill fallbacks, MLflow bootstrapping (TensorBoard only
+in this environment).
+"""
+
+import logging
+
+from ..config.env_config import EnvConfig
+from ..config.mcts_config import MCTSConfig
+from ..config.mesh_config import MeshConfig
+from ..config.model_config import ModelConfig
+from ..config.persistence_config import PersistenceConfig
+from ..config.train_config import TrainConfig
+from ..logging_config import setup_logging
+from ..stats.persistence import CheckpointManager
+from .loop import LoopStatus, TrainingLoop
+from .setup import setup_training_components
+
+logger = logging.getLogger(__name__)
+
+EXIT_CODES = {
+    LoopStatus.COMPLETED: 0,
+    LoopStatus.STOPPED: 0,
+    LoopStatus.ERROR: 1,
+}
+
+
+def _resolve_auto_resume(
+    train_config: TrainConfig, persistence: PersistenceConfig
+) -> tuple[TrainConfig, PersistenceConfig]:
+    """Point RUN_NAME at the newest checkpointed run when auto-resume is
+    on and that run isn't this one already (reference `README.md:23`,
+    `setup.py:174-176`)."""
+    if not train_config.AUTO_RESUME_LATEST:
+        return train_config, persistence
+    latest = CheckpointManager.find_latest_run(persistence)
+    if latest is None or latest == train_config.RUN_NAME:
+        return train_config, persistence
+    logger.info("Auto-resume: continuing latest run '%s'.", latest)
+    return (
+        train_config.model_copy(update={"RUN_NAME": latest}),
+        persistence.model_copy(update={"RUN_NAME": latest}),
+    )
+
+
+def run_training(
+    train_config: TrainConfig | None = None,
+    env_config: EnvConfig | None = None,
+    model_config: ModelConfig | None = None,
+    mcts_config: MCTSConfig | None = None,
+    mesh_config: MeshConfig | None = None,
+    persistence_config: PersistenceConfig | None = None,
+    log_level: str = "INFO",
+    use_tensorboard: bool = True,
+) -> int:
+    """Run a full training session; returns a process exit code."""
+    setup_logging(log_level)
+    train_config = train_config or TrainConfig()
+    persistence_config = persistence_config or PersistenceConfig(
+        RUN_NAME=train_config.RUN_NAME
+    )
+    train_config, persistence_config = _resolve_auto_resume(
+        train_config, persistence_config
+    )
+
+    try:
+        components = setup_training_components(
+            train_config=train_config,
+            env_config=env_config,
+            model_config=model_config,
+            mcts_config=mcts_config,
+            mesh_config=mesh_config,
+            persistence_config=persistence_config,
+            use_tensorboard=use_tensorboard,
+        )
+    except Exception:
+        logger.exception("Component setup failed.")
+        return 1
+
+    loop = TrainingLoop(components)
+    try:
+        if train_config.LOAD_CHECKPOINT_PATH:
+            loaded = components.checkpoints.restore_path(
+                train_config.LOAD_CHECKPOINT_PATH, components.trainer.state
+            )
+        else:
+            loaded = components.checkpoints.restore(
+                components.trainer.state, buffer=components.buffer
+            )
+        if train_config.LOAD_BUFFER_PATH:
+            components.checkpoints.restore_buffer_path(
+                components.buffer, train_config.LOAD_BUFFER_PATH
+            )
+        if loaded.train_state is not None:
+            components.trainer.set_state(loaded.train_state)
+            components.trainer.sync_to_network()
+            loop.set_initial_state(
+                loaded.global_step,
+                int(loaded.counters.get("episodes_played", 0)),
+                int(loaded.counters.get("total_simulations", 0)),
+            )
+            loop.weight_updates = int(
+                loaded.counters.get("weight_updates", 0)
+            )
+            logger.info(
+                "Resumed at step %d (%d episodes, buffer %s).",
+                loaded.global_step,
+                loop.episodes_played,
+                len(components.buffer),
+            )
+    except Exception:
+        # Training a fresh model into an existing run's directory would
+        # pollute its checkpoints; abort instead (the user can disable
+        # AUTO_RESUME_LATEST or fix the path).
+        logger.exception(
+            "State restore failed for run '%s'; aborting rather than "
+            "writing a fresh model into its run directory.",
+            train_config.RUN_NAME,
+        )
+        return 1
+
+    status = loop.run()
+    components.stats.close()
+    components.checkpoints.close()
+    logger.info("Training finished: %s", status.value)
+    return EXIT_CODES[status]

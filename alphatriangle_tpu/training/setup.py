@@ -1,0 +1,116 @@
+"""Component construction (reference `training/setup.py:29-239`).
+
+The reference's setup is dominated by Ray: `ray.init` fallbacks, CPU
+detection and worker clamping, detached-actor discovery. None of that
+exists here — setup is pure object construction plus config validation,
+mesh building, and checkpoint-manager creation. Errors propagate; there
+are no actors to tear down on failure.
+"""
+
+import logging
+
+from ..config.env_config import EnvConfig
+from ..config.mcts_config import MCTSConfig
+from ..config.mesh_config import MeshConfig
+from ..config.model_config import ModelConfig
+from ..config.persistence_config import PersistenceConfig
+from ..config.train_config import TrainConfig
+from ..config.validation import print_config_info_and_validate
+from ..env.engine import TriangleEnv
+from ..features.core import get_feature_extractor
+from ..nn.network import NeuralNetwork
+from ..rl.buffer import ExperienceBuffer
+from ..rl.self_play import SelfPlayEngine
+from ..rl.trainer import Trainer
+from ..stats.collector import StatsCollector
+from ..stats.persistence import CheckpointManager
+from .components import TrainingComponents
+
+logger = logging.getLogger(__name__)
+
+
+def setup_training_components(
+    train_config: TrainConfig | None = None,
+    env_config: EnvConfig | None = None,
+    model_config: ModelConfig | None = None,
+    mcts_config: MCTSConfig | None = None,
+    mesh_config: MeshConfig | None = None,
+    persistence_config: PersistenceConfig | None = None,
+    use_tensorboard: bool = True,
+) -> TrainingComponents:
+    """Validate configs and build every training component."""
+    configs = print_config_info_and_validate(
+        env=env_config,
+        model=model_config,
+        train=train_config,
+        mcts=mcts_config,
+        mesh=mesh_config,
+        persistence=persistence_config,
+    )
+    env_config = configs["env"]
+    model_config = configs["model"]
+    train_config = configs["train"]
+    mcts_config = configs["mcts"]
+    mesh_config = configs["mesh"]
+    persistence_config = configs["persistence"]
+    # The run's artifacts live under its RUN_NAME.
+    if persistence_config.RUN_NAME != train_config.RUN_NAME:
+        persistence_config = persistence_config.model_copy(
+            update={"RUN_NAME": train_config.RUN_NAME}
+        )
+
+    try:
+        mesh = mesh_config.build_mesh()
+    except ValueError as exc:
+        logger.warning("Mesh build failed (%s); single-device fallback.", exc)
+        mesh = MeshConfig.single_device_mesh()
+
+    env = TriangleEnv(env_config)
+    extractor = get_feature_extractor(env, model_config)
+    net = NeuralNetwork(
+        model_config, env_config, seed=train_config.RANDOM_SEED
+    )
+    trainer = Trainer(net, train_config, mesh=mesh)
+    buffer = ExperienceBuffer(train_config, action_dim=env_config.action_dim)
+    self_play = SelfPlayEngine(
+        env,
+        extractor,
+        net,
+        mcts_config,
+        train_config,
+        seed=train_config.RANDOM_SEED + 1,
+    )
+    stats = StatsCollector(persistence_config, use_tensorboard=use_tensorboard)
+    checkpoints = CheckpointManager(persistence_config)
+    checkpoints.save_configs(
+        {
+            "env": env_config,
+            "model": model_config,
+            "train": train_config,
+            "mcts": mcts_config,
+            "mesh": mesh_config,
+            "persistence": persistence_config,
+        }
+    )
+    logger.info(
+        "Components ready: mesh %s, self-play batch %d, run %s",
+        dict(mesh.shape),
+        self_play.batch_size,
+        persistence_config.RUN_NAME,
+    )
+    return TrainingComponents(
+        env=env,
+        extractor=extractor,
+        net=net,
+        buffer=buffer,
+        trainer=trainer,
+        self_play=self_play,
+        stats=stats,
+        checkpoints=checkpoints,
+        env_config=env_config,
+        model_config=model_config,
+        train_config=train_config,
+        mcts_config=mcts_config,
+        mesh_config=mesh_config,
+        persistence_config=persistence_config,
+    )

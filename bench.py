@@ -1,0 +1,158 @@
+"""Benchmark: batched self-play throughput on the available accelerator.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "extra": {...}}
+
+Primary metric: **self-play games/hour**, measured directly (episodes
+completed / wall-clock) with the flagship configuration — default 8x15
+board, conv+residual+transformer net, 64-sim batched MCTS — on one
+chip. `vs_baseline` divides by the BASELINE.json north star (10,000
+games/hour on v4-8 with a 4-layer transformer net); the reference
+itself publishes no numbers (BASELINE.md).
+
+`extra` carries the secondary BASELINE metrics: MCTS leaf-evals/sec
+(per chip) and learner steps/sec on a 256 batch.
+
+Env knobs: BENCH_SMOKE=1 shrinks everything for a fast CPU sanity run;
+BENCH_SECONDS overrides the self-play measurement window.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from alphatriangle_tpu.config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+    from alphatriangle_tpu.env.engine import TriangleEnv
+    from alphatriangle_tpu.features.core import get_feature_extractor
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+    from alphatriangle_tpu.rl import SelfPlayEngine, Trainer
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    seconds = float(os.environ.get("BENCH_SECONDS", "8" if smoke else "75"))
+    backend = jax.default_backend()
+    device = jax.devices()[0]
+    log(f"bench: backend={backend} device={device.device_kind if hasattr(device, 'device_kind') else device}")
+
+    env_cfg = EnvConfig()
+    model_cfg = ModelConfig(
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=8 if smoke else 64, max_depth=4 if smoke else 8
+    )
+    sp_batch = 16 if smoke else 512
+    train_cfg = TrainConfig(
+        SELF_PLAY_BATCH_SIZE=sp_batch,
+        BATCH_SIZE=32 if smoke else 256,
+        BUFFER_CAPACITY=10_000,
+        MIN_BUFFER_SIZE_TO_TRAIN=1_000,
+        MAX_TRAINING_STEPS=1_000,
+        RUN_NAME="bench",
+    )
+
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    engine = SelfPlayEngine(
+        env, extractor, net, mcts_cfg, train_cfg, seed=0
+    )
+
+    # --- self-play games/hour (primary) --------------------------------
+    log("bench: compiling self-play move (first dispatch)...")
+    t0 = time.time()
+    engine.play_move()
+    compile_s = time.time() - t0
+    log(f"bench: first move (compile) {compile_s:.1f}s; measuring {seconds:.0f}s...")
+    engine.harvest()  # reset counters after warmup
+
+    t0 = time.time()
+    moves = 0
+    while time.time() - t0 < seconds:
+        engine.play_move()
+        moves += 1
+    elapsed = time.time() - t0
+    result = engine.harvest()
+    episodes = result.num_episodes
+    games_per_hour = episodes / elapsed * 3600.0
+    sims = mcts_cfg.max_simulations
+    leaf_evals_per_sec = moves * sp_batch * (sims + 1) / elapsed
+    moves_per_sec = moves * sp_batch / elapsed
+    log(
+        f"bench: {moves} lockstep moves x {sp_batch} games in {elapsed:.1f}s "
+        f"-> {episodes} episodes, {games_per_hour:.0f} games/h, "
+        f"{leaf_evals_per_sec:.0f} leaf-evals/s"
+    )
+
+    # --- learner steps/sec (secondary) ----------------------------------
+    trainer = Trainer(net, train_cfg)
+    b = train_cfg.BATCH_SIZE
+    rng = np.random.default_rng(0)
+    policy = rng.random((b, env_cfg.action_dim)).astype(np.float32)
+    policy /= policy.sum(axis=1, keepdims=True)
+    batch = {
+        "grid": rng.integers(-1, 2, size=(b, 1, env_cfg.ROWS, env_cfg.COLS)).astype(
+            np.float32
+        ),
+        "other_features": rng.random(
+            (b, model_cfg.OTHER_NN_INPUT_FEATURES_DIM)
+        ).astype(np.float32),
+        "policy_target": policy,
+        "value_target": rng.uniform(-5, 5, b).astype(np.float32),
+        "weights": np.ones(b, np.float32),
+    }
+    trainer.train_step(batch)  # compile
+    n_steps = 5 if smoke else 30
+    t0 = time.time()
+    for _ in range(n_steps):
+        trainer.train_step(batch)
+    jax.block_until_ready(trainer.state.params)
+    learner_steps_per_sec = n_steps / (time.time() - t0)
+    log(f"bench: learner {learner_steps_per_sec:.2f} steps/s (batch {b})")
+
+    north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)
+    out = {
+        "metric": "self_play_games_per_hour",
+        "value": round(games_per_hour, 1),
+        "unit": "games/hour",
+        "vs_baseline": round(games_per_hour / north_star, 4),
+        "extra": {
+            "backend": backend,
+            "self_play_batch": sp_batch,
+            "mcts_simulations": sims,
+            "episodes_completed": episodes,
+            "measure_seconds": round(elapsed, 1),
+            "mean_episode_length": (
+                round(float(np.mean(result.episode_lengths)), 1)
+                if result.episode_lengths
+                else None
+            ),
+            "moves_per_sec": round(moves_per_sec, 1),
+            "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
+            "learner_steps_per_sec": round(learner_steps_per_sec, 2),
+            "learner_batch": b,
+            "first_move_compile_seconds": round(compile_s, 1),
+        },
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

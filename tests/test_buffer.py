@@ -139,10 +139,10 @@ class TestPER:
 
     def test_beta_annealing(self):
         buf = ExperienceBuffer(per_cfg())
-        assert buf._beta(0) == pytest.approx(0.4)
-        assert buf._beta(50) == pytest.approx(0.7)
-        assert buf._beta(100) == pytest.approx(1.0)
-        assert buf._beta(10_000) == pytest.approx(1.0)
+        assert buf.beta(0) == pytest.approx(0.4)
+        assert buf.beta(50) == pytest.approx(0.7)
+        assert buf.beta(100) == pytest.approx(1.0)
+        assert buf.beta(10_000) == pytest.approx(1.0)
 
     def test_new_items_get_max_priority(self):
         buf = ExperienceBuffer(per_cfg())

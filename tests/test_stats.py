@@ -1,0 +1,190 @@
+"""Stats collector + Orbax persistence tests (trieye-equivalent surface;
+VERDICT.md #10 'Done =' bar: kill a run mid-training, rerun, resume)."""
+
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import PersistenceConfig, TrainConfig
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.rl import ExperienceBuffer, Trainer
+from alphatriangle_tpu.stats import (
+    CheckpointManager,
+    RawMetricEvent,
+    StatsCollector,
+)
+
+
+class TestCollector:
+    def test_aggregates_means_per_tick(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.log_scalar("Loss/Total", 4.0, step=1)
+        col.log_scalar("Loss/Total", 2.0, step=1)
+        col.log_event(RawMetricEvent(name="score", value=7.0, global_step=1))
+        means = col.process_and_log(1)
+        assert means["Loss/Total"] == pytest.approx(3.0)
+        assert means["score"] == pytest.approx(7.0)
+        # Window cleared after the tick.
+        assert col.process_and_log(2) == {}
+        assert col.get_series("Loss/Total") == [(1, 3.0)]
+        assert col.latest("score") == 7.0
+        col.close()
+
+    def test_nonfinite_dropped(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.log_scalar("x", float("nan"))
+        col.log_scalar("x", float("inf"))
+        assert col.process_and_log(0) == {}
+        col.close()
+
+    def test_tensorboard_files_written(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.log_scalar("m", 1.0, 0)
+        col.process_and_log(0)
+        col.close()
+        assert list((tmp_path / "tb").glob("events.out.tfevents.*"))
+
+
+def per_cfg(tmp_path, run="run_a") -> PersistenceConfig:
+    return PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME=run)
+
+
+class TestCheckpointManager:
+    def test_train_state_roundtrip(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        from tests.test_trainer import make_batch
+
+        trainer.train_step(make_batch())
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        counters = {"episodes_played": 5, "total_simulations": 99}
+        mgr.save(1, trainer.state, counters=counters)
+        mgr.wait_until_finished()
+
+        # Fresh process-equivalent: new net/trainer, restore by template.
+        net2 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=123)
+        trainer2 = Trainer(net2, tiny_train_config)
+        loaded = mgr.restore(trainer2.state)
+        assert loaded.global_step == 1
+        assert loaded.counters["episodes_played"] == 5
+        trainer2.set_state(loaded.train_state)
+        import jax
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(trainer.state.params),
+            jax.tree_util.tree_leaves(trainer2.state.params),
+            strict=True,
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(trainer2.state.step) == 1
+
+    def test_restore_empty_run(self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        loaded = mgr.restore(trainer.state)
+        assert loaded.train_state is None
+        assert loaded.global_step == 0
+
+    def test_buffer_spill_roundtrip(self, tmp_path):
+        tc = TrainConfig(
+            BATCH_SIZE=4, BUFFER_CAPACITY=64, MIN_BUFFER_SIZE_TO_TRAIN=8,
+            USE_PER=True, PER_BETA_ANNEAL_STEPS=10, MAX_TRAINING_STEPS=10,
+            RUN_NAME="t",
+        )
+        from tests.test_buffer import make_dense
+
+        buf = ExperienceBuffer(tc)
+        buf.add_dense(*make_dense(20, value=2.5))
+        buf.update_priorities(np.arange(20), np.linspace(0.5, 3.0, 20))
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        mgr.save_buffer(7, buf)
+
+        buf2 = ExperienceBuffer(tc)
+        assert mgr.restore_buffer(buf2)
+        assert len(buf2) == 20
+        np.testing.assert_array_equal(
+            buf2._storage["value_target"][:20],
+            buf._storage["value_target"][:20],
+        )
+        np.testing.assert_allclose(
+            buf2.tree.tree[buf2.tree._cap2 : buf2.tree._cap2 + 20],
+            buf.tree.tree[buf.tree._cap2 : buf.tree._cap2 + 20],
+        )
+
+    def test_restore_explicit_path(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        mgr.save(5, trainer.state, counters={"episodes_played": 2})
+        mgr.wait_until_finished()
+        path = per_cfg(tmp_path).get_checkpoint_dir() / "step_00000005"
+
+        net2 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=9)
+        trainer2 = Trainer(net2, tiny_train_config)
+        mgr2 = CheckpointManager(per_cfg(tmp_path, "other_run"))
+        loaded = mgr2.restore_path(path, trainer2.state)
+        assert loaded.global_step == 5
+        assert loaded.counters["episodes_played"] == 2
+        with pytest.raises(FileNotFoundError):
+            mgr2.restore_path(tmp_path / "nope", trainer2.state)
+
+    def test_restore_buffer_explicit_path(self, tmp_path):
+        tc = TrainConfig(
+            BATCH_SIZE=4, BUFFER_CAPACITY=64, MIN_BUFFER_SIZE_TO_TRAIN=8,
+            USE_PER=False, MAX_TRAINING_STEPS=10, RUN_NAME="t",
+        )
+        from tests.test_buffer import make_dense
+
+        buf = ExperienceBuffer(tc)
+        buf.add_dense(*make_dense(10))
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        spill = mgr.save_buffer(3, buf)
+        buf2 = ExperienceBuffer(tc)
+        assert CheckpointManager.restore_buffer_path(buf2, spill)
+        assert len(buf2) == 10
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager.restore_buffer_path(buf2, tmp_path / "nope.npz")
+
+    def test_latest_step_and_multiple_saves(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        mgr.save(3, trainer.state)
+        mgr.save(12, trainer.state)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 12
+
+    def test_find_latest_run(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        mgr_a = CheckpointManager(per_cfg(tmp_path, "run_a"))
+        mgr_a.save(1, trainer.state)
+        mgr_a.wait_until_finished()
+        import time
+
+        time.sleep(0.05)
+        mgr_b = CheckpointManager(per_cfg(tmp_path, "run_b"))
+        mgr_b.save(2, trainer.state)
+        mgr_b.wait_until_finished()
+        # run_c has dirs but no checkpoints -> ignored.
+        CheckpointManager(per_cfg(tmp_path, "run_c"))
+        assert CheckpointManager.find_latest_run(per_cfg(tmp_path)) == "run_b"
+
+    def test_save_configs(self, tmp_path, tiny_env_config):
+        mgr = CheckpointManager(per_cfg(tmp_path))
+        mgr.save_configs({"env": tiny_env_config, "note": "x"})
+        import json
+
+        data = json.loads(
+            (per_cfg(tmp_path).get_run_base_dir() / "configs.json").read_text()
+        )
+        assert data["env"]["ROWS"] == 3
+        assert data["note"] == "x"

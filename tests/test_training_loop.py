@@ -1,0 +1,156 @@
+"""Loop/runner integration tests (reference
+`tests/training/test_loop_integration.py:328-428` — but with REAL
+components instead of mocks, as VERDICT.md #9 demands: a tiny-config
+end-to-end run on CPU, then kill + resume)."""
+
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import (
+    PersistenceConfig,
+    TrainConfig,
+    expected_other_features_dim,
+)
+from alphatriangle_tpu.config.env_config import EnvConfig
+from alphatriangle_tpu.config.mcts_config import AlphaTriangleMCTSConfig
+from alphatriangle_tpu.config.model_config import ModelConfig
+from alphatriangle_tpu.training import (
+    LoopStatus,
+    TrainingLoop,
+    run_training,
+    setup_training_components,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_world_configs(tiny_env_config, tiny_model_config, tiny_mcts_config):
+    return tiny_env_config, tiny_model_config, tiny_mcts_config
+
+
+def make_train_cfg(run_name: str, root: str, **kw) -> TrainConfig:
+    base = dict(
+        RUN_NAME=run_name,
+        AUTO_RESUME_LATEST=False,
+        MAX_TRAINING_STEPS=8,
+        SELF_PLAY_BATCH_SIZE=4,
+        ROLLOUT_CHUNK_MOVES=4,
+        BATCH_SIZE=8,
+        BUFFER_CAPACITY=2000,
+        MIN_BUFFER_SIZE_TO_TRAIN=16,
+        USE_PER=True,
+        PER_BETA_ANNEAL_STEPS=8,
+        N_STEP_RETURNS=2,
+        WORKER_UPDATE_FREQ_STEPS=2,
+        CHECKPOINT_SAVE_FREQ_STEPS=4,
+        MAX_EPISODE_MOVES=30,
+        RANDOM_SEED=5,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def build(tmp_path, cfgs, run_name="loop_run", **kw):
+    env_cfg, model_cfg, mcts_cfg = cfgs
+    tc = make_train_cfg(run_name, str(tmp_path), **kw)
+    pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME=run_name)
+    return setup_training_components(
+        train_config=tc,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=pc,
+        use_tensorboard=False,
+    )
+
+
+class TestLoop:
+    def test_end_to_end_tiny_run(self, tmp_path, tiny_world_configs):
+        c = build(tmp_path, tiny_world_configs)
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 8
+        assert loop.episodes_played > 0
+        # Weight sync cadence honored (every 2 steps -> 4 updates).
+        assert loop.weight_updates == 4
+        assert c.net.weights_version == 4
+        # Metrics flowed through the collector.
+        assert c.stats.latest("Loss/total_loss") is not None
+        assert c.stats.latest("Buffer/Size") > 0
+        assert c.stats.latest("PER/Beta") == pytest.approx(1.0)
+        # Checkpoints: cadence (step 4) + final (step 8).
+        assert c.checkpoints.latest_step() == 8
+        steps = sorted(
+            int(p.name.split("_")[1])
+            for p in c.persistence_config.get_checkpoint_dir().iterdir()
+            if p.is_dir()
+        )
+        assert 4 in steps and 8 in steps
+        c.stats.close()
+        c.checkpoints.close()
+
+    def test_stop_event(self, tmp_path, tiny_world_configs):
+        c = build(
+            tmp_path, tiny_world_configs, run_name="stop_run",
+            MAX_TRAINING_STEPS=1000, BUFFER_CAPACITY=200_000,
+            MIN_BUFFER_SIZE_TO_TRAIN=100_000,
+        )
+        loop = TrainingLoop(c)
+        loop.stop_event.set()
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 0
+        c.stats.close()
+        c.checkpoints.close()
+
+
+class TestRunnerResume:
+    def test_run_training_and_resume(self, tmp_path, tiny_world_configs):
+        """VERDICT #10 bar: run, 'kill', rerun -> resumes from latest."""
+        env_cfg, model_cfg, mcts_cfg = tiny_world_configs
+        pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="resume_run")
+        tc = make_train_cfg("resume_run", str(tmp_path), MAX_TRAINING_STEPS=4)
+        rc = run_training(
+            train_config=tc,
+            env_config=env_cfg,
+            model_config=model_cfg,
+            mcts_config=mcts_cfg,
+            persistence_config=pc,
+            use_tensorboard=False,
+            log_level="WARNING",
+        )
+        assert rc == 0
+
+        # Second session, auto-resume on, longer horizon: must continue
+        # from step 4, not restart.
+        tc2 = make_train_cfg(
+            "fresh_name", str(tmp_path),
+            MAX_TRAINING_STEPS=6, AUTO_RESUME_LATEST=True,
+        )
+        pc2 = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="fresh_name")
+        rc = run_training(
+            train_config=tc2,
+            env_config=env_cfg,
+            model_config=model_cfg,
+            mcts_config=mcts_cfg,
+            persistence_config=pc2,
+            use_tensorboard=False,
+            log_level="WARNING",
+        )
+        assert rc == 0
+        # The resumed run continued in the original run dir.
+        from alphatriangle_tpu.stats import CheckpointManager
+
+        mgr = CheckpointManager(
+            PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="resume_run")
+        )
+        assert mgr.latest_step() == 6
+        # Counters persisted across sessions.
+        import json
+
+        meta = json.loads(
+            (
+                mgr.config.get_checkpoint_dir() / "step_00000006.meta.json"
+            ).read_text()
+        )
+        assert meta["episodes_played"] > 0
