@@ -1,0 +1,206 @@
+"""Command-line interface (reference `alphatriangle/cli.py:31-326`).
+
+Subcommands mirror the reference's Typer app: `train` (config
+overrides -> `run_training`), `tb` (launch TensorBoard on the runs
+root), `ml` (MLflow launcher — degrades with a clear message when
+MLflow isn't installed, as in this TPU image). The reference's `ray`
+command has no equivalent: there is no actor runtime to inspect; the
+device story lives in `jax.devices()` (printed by `devices`).
+
+Console script: `alphatriangle-tpu` (pyproject `[project.scripts]`,
+reference `pyproject.toml:53-54`).
+"""
+
+import argparse
+import logging
+import subprocess
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
+    p = sub.add_parser("train", help="Run a training session.")
+    # Reference override surface (`cli.py:40-74`).
+    p.add_argument("--run-name", default=None, help="Run directory name.")
+    p.add_argument("--seed", type=int, default=None, help="Random seed.")
+    p.add_argument(
+        "--log-level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+    )
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="Capture a jax.profiler trace + per-phase timers into "
+        "runs/<run>/profile_data/.",
+    )
+    # TPU-native sizing knobs.
+    p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--self-play-batch", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--buffer-capacity", type=int, default=None)
+    p.add_argument("--min-buffer", type=int, default=None)
+    p.add_argument("--rollout-chunk", type=int, default=None)
+    p.add_argument("--no-per", action="store_true")
+    p.add_argument(
+        "--no-auto-resume",
+        action="store_true",
+        help="Start fresh instead of resuming the latest run.",
+    )
+    p.add_argument("--load-checkpoint", default=None, metavar="PATH")
+    p.add_argument("--load-buffer", default=None, metavar="PATH")
+    p.add_argument("--root-dir", default=None, help="Runs root directory.")
+    p.add_argument("--no-tensorboard", action="store_true")
+    p.add_argument(
+        "--device",
+        default=None,
+        choices=["auto", "tpu", "cpu"],
+        help="Compute platform; cpu forces the CPU backend even when an "
+        "accelerator plugin is present.",
+    )
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    from .config import PersistenceConfig, TrainConfig
+    from .training.runner import run_training
+
+    overrides: dict = {}
+    if args.run_name is not None:
+        overrides["RUN_NAME"] = args.run_name
+    if args.seed is not None:
+        overrides["RANDOM_SEED"] = args.seed
+    if args.max_steps is not None:
+        overrides["MAX_TRAINING_STEPS"] = args.max_steps
+    if args.self_play_batch is not None:
+        overrides["SELF_PLAY_BATCH_SIZE"] = args.self_play_batch
+    if args.batch_size is not None:
+        overrides["BATCH_SIZE"] = args.batch_size
+    if args.buffer_capacity is not None:
+        overrides["BUFFER_CAPACITY"] = args.buffer_capacity
+    if args.min_buffer is not None:
+        overrides["MIN_BUFFER_SIZE_TO_TRAIN"] = args.min_buffer
+    if args.rollout_chunk is not None:
+        overrides["ROLLOUT_CHUNK_MOVES"] = args.rollout_chunk
+    if args.no_per:
+        overrides["USE_PER"] = False
+    if args.no_auto_resume:
+        overrides["AUTO_RESUME_LATEST"] = False
+    if args.load_checkpoint is not None:
+        overrides["LOAD_CHECKPOINT_PATH"] = args.load_checkpoint
+    if args.load_buffer is not None:
+        overrides["LOAD_BUFFER_PATH"] = args.load_buffer
+    if args.profile:
+        overrides["PROFILE_WORKERS"] = True
+    if args.device is not None:
+        overrides["DEVICE"] = args.device
+    train_config = TrainConfig(**overrides)
+
+    persistence_config = None
+    if args.root_dir is not None:
+        persistence_config = PersistenceConfig(
+            ROOT_DATA_DIR=args.root_dir, RUN_NAME=train_config.RUN_NAME
+        )
+    return run_training(
+        train_config=train_config,
+        persistence_config=persistence_config,
+        log_level=args.log_level,
+        use_tensorboard=not args.no_tensorboard,
+    )
+
+
+def _launch_ui(tool: str, argv: list[str]) -> int:
+    """Run a dashboard tool in the foreground (reference `cli.py:85-137`)."""
+    try:
+        __import__(tool)
+    except ImportError:
+        print(
+            f"{tool} is not installed in this environment. "
+            f"Install it to use this command.",
+            file=sys.stderr,
+        )
+        return 1
+    cmd = [sys.executable, "-m", tool, *argv]
+    print(f"Launching: {' '.join(cmd)} (Ctrl-C to stop)")
+    try:
+        return subprocess.call(cmd)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_tb(args: argparse.Namespace) -> int:
+    from .config import PersistenceConfig
+
+    root = args.root_dir or PersistenceConfig().ROOT_DATA_DIR
+    return _launch_ui(
+        "tensorboard", ["--logdir", root, "--port", str(args.port)]
+    )
+
+
+def cmd_ml(args: argparse.Namespace) -> int:
+    from .config import PersistenceConfig
+
+    root = args.root_dir or PersistenceConfig().ROOT_DATA_DIR
+    return _launch_ui(
+        "mlflow", ["ui", "--backend-store-uri", root, "--port", str(args.port)]
+    )
+
+
+def cmd_devices(_args: argparse.Namespace) -> int:
+    import jax
+
+    from .utils.helpers import enforce_platform
+
+    # Honor JAX_PLATFORMS=cpu even when a site hook re-forces the
+    # accelerator plugin (whose init can hang on a sick chip).
+    enforce_platform("auto")
+    print(f"backend: {jax.default_backend()}")
+    for d in jax.devices():
+        print(f"  {d.id}: {getattr(d, 'device_kind', d.platform)}")
+    return 0
+
+
+def cmd_analyze(args: argparse.Namespace) -> int:
+    from .profiling import analyze_profile_dir
+
+    return analyze_profile_dir(args.profile_dir, top=args.top)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="alphatriangle-tpu",
+        description="TPU-native AlphaZero training for the triangle puzzle.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    _add_train_parser(sub)
+
+    tb = sub.add_parser("tb", help="Launch TensorBoard over the runs root.")
+    tb.add_argument("--root-dir", default=None)
+    tb.add_argument("--port", type=int, default=6006)
+
+    ml = sub.add_parser("ml", help="Launch MLflow UI (when installed).")
+    ml.add_argument("--root-dir", default=None)
+    ml.add_argument("--port", type=int, default=5000)
+
+    sub.add_parser("devices", help="Show the JAX backend and devices.")
+
+    an = sub.add_parser(
+        "analyze", help="Summarize per-phase timer dumps from a profile run."
+    )
+    an.add_argument("profile_dir", help="runs/<run>/profile_data directory.")
+    an.add_argument("--top", type=int, default=20)
+
+    args = parser.parse_args(argv)
+    handlers = {
+        "train": cmd_train,
+        "tb": cmd_tb,
+        "ml": cmd_ml,
+        "devices": cmd_devices,
+        "analyze": cmd_analyze,
+    }
+    return handlers[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Profiling subsystem: device traces + per-phase host timers.
+
+TPU-native equivalent of the reference's worker profiling
+(`alphatriangle/rl/self_play/worker.py:99-104,549-566` cProfile dumps +
+`time.monotonic()` span logging) and its offline analyzer
+(`alphatriangle/analyze_profiles.py:41-78`):
+
+- `jax.profiler` trace of a bounded window of loop iterations (the
+  XLA/TPU story the reference's cProfile cannot see) written to
+  `runs/<run>/profile_data/`, viewable in TensorBoard's profile plugin.
+- `PhaseTimers`: per-phase wall-clock accumulators (rollout / sample /
+  train / checkpoint) kept for the WHOLE run, exported as metrics each
+  stats tick and dumped to `phase_timers.json` at exit.
+- `analyze_profile_dir`: prints a per-phase summary table from the
+  dump, replacing the reference's pstats top-N listing.
+"""
+
+import json
+import logging
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+class PhaseTimers:
+    """Accumulates wall-clock seconds per named phase."""
+
+    def __init__(self) -> None:
+        self._total: dict[str, float] = defaultdict(float)
+        self._count: dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._total[name] += time.perf_counter() - t0
+            self._count[name] += 1
+
+    def metrics(self) -> dict[str, float]:
+        """Mean milliseconds per phase, for the stats pipeline."""
+        return {
+            f"Profile/{name}_ms": 1000.0 * self._total[name] / self._count[name]
+            for name in self._total
+            if self._count[name]
+        }
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            name: {
+                "total_seconds": self._total[name],
+                "count": self._count[name],
+                "mean_ms": 1000.0 * self._total[name] / max(self._count[name], 1),
+            }
+            for name in sorted(self._total)
+        }
+
+    def dump(self, path: Path) -> None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.summary(), indent=2))
+
+
+class ProfileSession:
+    """Owns one run's profiling: a bounded device-trace window + timers.
+
+    The trace covers iterations [trace_start, trace_stop) — after the
+    first iteration so compilation doesn't dominate, and bounded so the
+    trace stays a viewable size (the reference bounds its cProfile per
+    episode for the same reason, `worker.py:172-173`).
+    """
+
+    def __init__(
+        self,
+        enabled: bool,
+        profile_dir: Path,
+        trace_start: int = 1,
+        trace_stop: int = 3,
+    ) -> None:
+        self.enabled = enabled
+        self.profile_dir = Path(profile_dir)
+        self.timers = PhaseTimers()
+        self._trace_start = trace_start
+        self._trace_stop = trace_stop
+        self._tracing = False
+
+    def phase(self, name: str):
+        return self.timers.phase(name)
+
+    def on_iteration(self, iteration: int) -> None:
+        """Called at the top of each loop iteration."""
+        if not self.enabled:
+            return
+        if iteration == self._trace_start and not self._tracing:
+            import jax
+
+            self.profile_dir.mkdir(parents=True, exist_ok=True)
+            logger.info(
+                "Profiling: starting jax.profiler trace into %s "
+                "(iterations %d-%d).",
+                self.profile_dir,
+                self._trace_start,
+                self._trace_stop - 1,
+            )
+            jax.profiler.start_trace(str(self.profile_dir))
+            self._tracing = True
+        elif iteration >= self._trace_stop and self._tracing:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._tracing = False
+        logger.info("Profiling: device trace written to %s.", self.profile_dir)
+
+    def close(self) -> None:
+        if self._tracing:
+            self._stop_trace()
+        if self.enabled:
+            self.timers.dump(self.profile_dir / "phase_timers.json")
+
+
+def analyze_profile_dir(profile_dir: str, top: int = 20) -> int:
+    """Print a per-phase summary of a profile run (CLI `analyze`)."""
+    root = Path(profile_dir)
+    dump = root / "phase_timers.json"
+    if dump.exists():
+        summary = json.loads(dump.read_text())
+        rows = sorted(
+            summary.items(),
+            key=lambda kv: kv[1]["total_seconds"],
+            reverse=True,
+        )[:top]
+        width = max((len(name) for name, _ in rows), default=5)
+        print(f"{'phase':<{width}}  {'total s':>9}  {'count':>7}  {'mean ms':>9}")
+        for name, s in rows:
+            print(
+                f"{name:<{width}}  {s['total_seconds']:>9.2f}  "
+                f"{s['count']:>7d}  {s['mean_ms']:>9.2f}"
+            )
+    else:
+        print(f"No phase_timers.json in {root}.")
+
+    traces = sorted(root.glob("**/*.xplane.pb"))
+    if traces:
+        print(f"\n{len(traces)} device trace(s):")
+        for t in traces[:top]:
+            print(f"  {t}")
+        print(
+            "View with: tensorboard --logdir "
+            f"{root} (PROFILE tab)"
+        )
+    elif not dump.exists():
+        return 1
+    return 0

@@ -256,24 +256,39 @@ class ExperienceBuffer:
 
     def set_state(self, state: dict[str, Any]) -> None:
         """Restore a `get_state` snapshot (shapes may differ from current
-        capacity; contents are clipped to fit)."""
+        capacity; contents are clipped to fit).
+
+        Snapshot rows are in *slot* order; for a wrapped ring the oldest
+        row sits at the old write position, not slot 0. Restore in
+        chronological order (oldest at slot 0, `_pos` after the newest)
+        so later ring writes overwrite oldest-first regardless of any
+        capacity change, and clipping keeps the NEWEST rows."""
         storage = state.get("storage")
         if storage is None:
             return
-        n = min(int(state["size"]), self.capacity)
-        first = storage["grid"][:n]
+        old_size = int(state["size"])
+        old_pos = int(state["pos"])
+        # Slot -> chronological order: a wrapped ring's oldest entry is
+        # at old_pos (an unwrapped one's pos == size, making this a no-op).
+        order = np.roll(np.arange(old_size), -(old_pos % max(old_size, 1)))
+        n = min(old_size, self.capacity)
+        order = order[-n:]  # keep newest on shrink
         self._ensure_storage(
-            first, storage["other_features"][:n], storage["policy_target"][:n]
+            storage["grid"][:1],
+            storage["other_features"][:1],
+            storage["policy_target"][:1],
         )
         assert self._storage is not None
         for k in self._storage:
-            self._storage[k][:n] = storage[k][:n]
+            self._storage[k][:n] = storage[k][order]
         self._size = n
-        self._pos = int(state["pos"]) % self.capacity if n >= self.capacity else n % self.capacity
+        self._pos = n % self.capacity
         if self.tree is not None:
             prios = state.get("priorities")
             if prios is None:
                 prios = np.ones(n, dtype=np.float64)
+            else:
+                prios = np.asarray(prios, dtype=np.float64)[order]
             # Write the full leaf range: slots >= n must be zeroed, or a
             # smaller snapshot restored over a fuller tree leaves stale
             # priorities inflating total_priority and hijacking sampling.

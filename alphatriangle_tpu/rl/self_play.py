@@ -1,4 +1,4 @@
-"""Batched self-play: lockstep rollouts + vectorized n-step pipeline.
+"""Batched self-play: fused lockstep rollouts + vectorized n-step pipeline.
 
 Capability parity with the reference's `SelfPlayWorker.run_episode`
 (`alphatriangle/rl/self_play/worker.py:166-513`): MCTS per move,
@@ -7,15 +7,19 @@ counts, n-step returns with value bootstrap, trailing flush of
 unmatured experiences at episode end, staleness tagging.
 
 TPU-native redesign (SURVEY.md §7 step 9):
-- One `SelfPlayEngine` steps `B` games in lockstep; each move is a
-  handful of batched device dispatches (feature extract, MCTS search —
-  which itself batches every leaf eval across games onto the MXU —
-  action select, env step). There are no per-game actors and no weight
-  broadcast; the engine reads the `NeuralNetwork` wrapper's current
-  variables each search, so a learner `sync_to_network()` is visible on
-  the very next move (replaces `worker_manager.py:169-209`).
+- One `SelfPlayEngine` steps `B` games in lockstep. A whole rollout
+  chunk (`play_chunk`) — search -> select -> env step -> n-step window
+  update, times `num_moves` — is ONE jitted dispatch: a `lax.scan` over
+  moves whose carry holds the env states *and* the n-step window as
+  device arrays. The host sees exactly one transfer per chunk (the
+  stacked, masked experience outputs), replacing the >=6 blocking
+  transfers per move of the round-2 engine.
+- There are no per-game actors and no weight broadcast; the engine
+  reads the `NeuralNetwork` wrapper's current variables at each chunk,
+  so a learner `sync_to_network()` is visible on the next chunk
+  (replaces `worker_manager.py:169-209`).
 - The n-step machinery is a **vectorized sliding window**: (B, n)
-  host arrays of pending experiences with incrementally-maintained
+  device arrays of pending experiences with incrementally-maintained
   discounted partial returns, instead of per-game Python deques
   (`worker.py:410-485`). An experience added at move t matures at move
   t+n and is bootstrapped with that search's root value — the
@@ -23,18 +27,26 @@ TPU-native redesign (SURVEY.md §7 step 9):
   reference's raw network bootstrap (`worker.py:418`).
 - Games that finish flush their window without bootstrap (trailing
   flush, `worker.py:466-485`) and are reset in place, so the batch
-  never shrinks and shapes stay static.
+  never shrinks and shapes stay static. Emissions use fixed-shape
+  (moves, B[, n]) buffers with boolean masks; the host compacts them
+  after the single device_get.
+- Staleness is tracked per episode: each game carries the weights
+  version it started under; episode-end records it (finer than the
+  reference's per-episode tag at `worker.py:136-139`, which tags with
+  the version at *episode start* too — parity, but batched).
 """
 
+import functools
 import logging
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import struct
 
 from ..config.mcts_config import MCTSConfig
 from ..config.train_config import TrainConfig
-from ..env.engine import TriangleEnv
+from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
 from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
 from ..mcts.search import BatchedMCTS
@@ -42,6 +54,22 @@ from ..nn.network import NeuralNetwork
 from .types import SelfPlayResult
 
 logger = logging.getLogger(__name__)
+
+
+@struct.dataclass
+class RolloutCarry:
+    """Device-resident rollout state carried across chunks."""
+
+    env: EnvState  # (B, ...) lockstep game states
+    rng: jax.Array  # PRNG key
+    pend_grid: jax.Array  # (B, n, C, H, W) float32 pending features
+    pend_other: jax.Array  # (B, n, F) float32
+    pend_policy: jax.Array  # (B, n, A) float32 pending policy targets
+    pend_return: jax.Array  # (B, n) float32 discounted partial returns
+    pend_discount: jax.Array  # (B, n) float32 next-reward discounts
+    pend_active: jax.Array  # (B, n) bool slot occupancy
+    episode_start_version: jax.Array  # (B,) int32 weights version at ep start
+    move_index: jax.Array  # () int32 global move counter
 
 
 class SelfPlayEngine:
@@ -69,159 +97,253 @@ class SelfPlayEngine:
         self.n_step = train_config.N_STEP_RETURNS
         self.gamma = train_config.GAMMA
 
-        self._rng = jax.random.PRNGKey(seed)
-        self._rng, reset_key = jax.random.split(self._rng)
-        self.states = env.reset_batch(
-            jax.random.split(reset_key, self.batch_size)
-        )
-
         b, n = self.batch_size, self.n_step
         c = extractor.model_config.GRID_INPUT_CHANNELS
         f = extractor.other_dim
         a = env.action_dim
         self._grid_shape = (c, env.rows, env.cols)
-        self._pend_grid = np.zeros((b, n, c, env.rows, env.cols), np.float32)
-        self._pend_other = np.zeros((b, n, f), np.float32)
-        self._pend_policy = np.zeros((b, n, a), np.float32)
-        self._pend_return = np.zeros((b, n), np.float32)
-        self._pend_discount = np.ones((b, n), np.float32)
-        self._pend_active = np.zeros((b, n), bool)
+        self._other_dim = f
+        self._action_dim = a
 
-        self._move_index = 0  # global move counter (window slot = t % n)
+        rng = jax.random.PRNGKey(seed)
+        rng, reset_key = jax.random.split(rng)
+        version0 = self.net.weights_version
+        self._carry = RolloutCarry(
+            env=env.reset_batch(jax.random.split(reset_key, b)),
+            rng=rng,
+            pend_grid=jnp.zeros((b, n, c, env.rows, env.cols), jnp.float32),
+            pend_other=jnp.zeros((b, n, f), jnp.float32),
+            pend_policy=jnp.zeros((b, n, a), jnp.float32),
+            pend_return=jnp.zeros((b, n), jnp.float32),
+            pend_discount=jnp.ones((b, n), jnp.float32),
+            pend_active=jnp.zeros((b, n), bool),
+            episode_start_version=jnp.full((b,), version0, jnp.int32),
+            move_index=jnp.int32(0),
+        )
+
+        # One compiled program per distinct chunk length, carry donated
+        # so XLA reuses the window buffers in place.
+        self._chunk_fn = functools.lru_cache(maxsize=None)(
+            lambda num_moves: jax.jit(
+                functools.partial(self._chunk, num_moves),
+                donate_argnums=(1,),
+            )
+        )
+
         # Oldest weights version contributing to the current harvest
-        # window (conservative staleness tag; a mid-window sync must not
-        # relabel earlier experiences as fresh). None = window not
-        # started; resolved at the first move of each window.
+        # window (conservative chunk-level tag; per-episode tags ride in
+        # episode_start_versions). None = window not started.
         self._min_weights_version: int | None = None
         self._out: list[tuple[np.ndarray, ...]] = []
         self._episode_scores: list[float] = []
         self._episode_lengths: list[int] = []
+        self._episode_start_versions: list[int] = []
         self._episodes_played = 0
         self._total_simulations = 0
+        # (T, B) per-move diagnostics of the most recent chunk.
+        self.last_trace: dict[str, np.ndarray] | None = None
 
-    def _next_key(self) -> jax.Array:
-        self._rng, key = jax.random.split(self._rng)
-        return key
+    # --- device-side chunk ------------------------------------------------
 
-    def _temperatures(self, step_counts: np.ndarray) -> np.ndarray:
+    def _temperatures(self, step_counts: jax.Array) -> jax.Array:
         """Per-game move-indexed temperature (reference `worker.py:311-332`)."""
         cfg = self.config
-        frac = np.minimum(
-            step_counts.astype(np.float32) / cfg.TEMPERATURE_ANNEAL_MOVES, 1.0
+        frac = jnp.minimum(
+            step_counts.astype(jnp.float32) / cfg.TEMPERATURE_ANNEAL_MOVES, 1.0
         )
         return cfg.TEMPERATURE_INITIAL + frac * (
             cfg.TEMPERATURE_FINAL - cfg.TEMPERATURE_INITIAL
         )
 
-    def _emit(self, mask: np.ndarray, slot_returns: np.ndarray, slots: slice | int):
-        """Queue pending experiences `[mask, slots]` with final returns."""
-        if not mask.any():
-            return
-        self._out.append(
-            (
-                self._pend_grid[mask, slots].reshape(-1, *self._grid_shape),
-                self._pend_other[mask, slots].reshape(
-                    -1, self._pend_other.shape[-1]
-                ),
-                self._pend_policy[mask, slots].reshape(
-                    -1, self._pend_policy.shape[-1]
-                ),
-                np.asarray(slot_returns[mask], np.float32).reshape(-1),
-            )
-        )
-
-    def play_move(self) -> None:
-        """Advance every game by one move."""
-        t = self._move_index
-        w = t % self.n_step
-        states = self.states
-        self._min_weights_version = (
-            self.net.weights_version
-            if self._min_weights_version is None
-            else min(self._min_weights_version, self.net.weights_version)
-        )
+    def _move_body(self, variables, version, carry: RolloutCarry, _):
+        """One lockstep move of all B games (scan body)."""
+        n = self.n_step
+        w = carry.move_index % n
+        states = carry.env
+        rng, k_search, k_select, k_reset = jax.random.split(carry.rng, 4)
 
         # 1-2. Features for replay + batched search (one MXU leaf batch
         # per simulation across all B games).
-        grids, others = self.extractor.extract_batch(states)
-        out = self.mcts.search(self.net.variables, states, self._next_key())
-        counts = np.asarray(out.visit_counts)
-        root_value = np.asarray(out.root_value)
-        self._total_simulations += int(out.total_simulations)
-
-        valid = np.asarray(self.env.valid_mask_batch(states))
-        policy = np.asarray(
-            policy_target_from_visits(out.visit_counts, jnp.asarray(valid))
-        )
+        grids, others = jax.vmap(self.extractor.extract)(states)
+        out = self.mcts._search(variables, states, k_search)
+        valid = jax.vmap(self.env.valid_action_mask)(states)
+        policy = policy_target_from_visits(out.visit_counts, valid)
 
         # 3. Mature the slot added n moves ago: bootstrap with this
-        # search's root value (the MCTS estimate of V(s_{t}) = V(s_{t-n+n})).
-        matured = self._pend_active[:, w].copy()
-        if matured.any():
-            boot = (
-                self._pend_return[:, w]
-                + self._pend_discount[:, w] * root_value
-            )
-            self._emit(matured, boot, w)
-            self._pend_active[:, w] = False
+        # search's root value (the MCTS estimate of V(s_t) = V(s_{t-n+n})).
+        mat_mask = carry.pend_active[:, w]
+        mat = {
+            "grid": carry.pend_grid[:, w],
+            "other": carry.pend_other[:, w],
+            "policy": carry.pend_policy[:, w],
+            "ret": carry.pend_return[:, w]
+            + carry.pend_discount[:, w] * out.root_value,
+            "mask": mat_mask,
+        }
+        pend_active = carry.pend_active.at[:, w].set(False)
 
         # 4. Select actions (temperature by each game's own move count)
-        # and step all games in one dispatch.
-        temps = self._temperatures(np.asarray(states.step_count))
-        actions = select_action_from_visits(
-            out.visit_counts, jnp.asarray(temps), self._next_key()
-        )
-        actions = jnp.maximum(actions, 0)  # sentinel guard (no-visit rows)
-        new_states, rewards, dones = self.env.step_batch(states, actions)
-        rewards_np = np.asarray(rewards)
-        dones_np = np.asarray(dones)
+        # and step all games in one vmapped transition.
+        temps = self._temperatures(states.step_count)
+        actions = select_action_from_visits(out.visit_counts, temps, k_select)
+        # Sentinel guard: -1 (zero root visits) only happens for finished
+        # games, where step() is a no-op; count live-game sentinels so the
+        # host can surface the anomaly instead of silently clamping.
+        sentinel_live = ((actions < 0) & ~states.done).sum(dtype=jnp.int32)
+        actions = jnp.maximum(actions, 0)
+        new_states, rewards, dones = jax.vmap(self.env.step)(states, actions)
 
         # 5. Add this move's experience into window slot w.
-        self._pend_grid[:, w] = np.asarray(grids)
-        self._pend_other[:, w] = np.asarray(others)
-        self._pend_policy[:, w] = policy
-        self._pend_return[:, w] = 0.0
-        self._pend_discount[:, w] = 1.0
-        self._pend_active[:, w] = True
+        pend_grid = carry.pend_grid.at[:, w].set(grids)
+        pend_other = carry.pend_other.at[:, w].set(others)
+        pend_policy = carry.pend_policy.at[:, w].set(policy)
+        pend_return = carry.pend_return.at[:, w].set(0.0)
+        pend_discount = carry.pend_discount.at[:, w].set(1.0)
+        pend_active = pend_active.at[:, w].set(True)
 
         # 6. Fold this move's reward into every pending experience.
-        self._pend_return += np.where(
-            self._pend_active, self._pend_discount * rewards_np[:, None], 0.0
+        pend_return = pend_return + jnp.where(
+            pend_active, pend_discount * rewards[:, None], 0.0
         )
-        self._pend_discount = np.where(
-            self._pend_active, self._pend_discount * self.gamma, 1.0
+        pend_discount = jnp.where(
+            pend_active, pend_discount * self.gamma, 1.0
         )
 
         # 7. Trailing flush for finished (or move-capped) games: emit all
         # pending slots without bootstrap (`worker.py:466-485`).
-        step_counts = np.asarray(new_states.step_count)
-        truncated = (~dones_np) & (step_counts >= self.config.MAX_EPISODE_MOVES)
-        ending = dones_np | truncated
-        if ending.any():
-            flush = self._pend_active & ending[:, None]
-            self._emit(flush, self._pend_return.copy(), slice(None))
-            self._pend_active[ending] = False
-            scores = np.asarray(new_states.score)
-            for b in np.flatnonzero(ending):
-                self._episode_scores.append(float(scores[b]))
-                self._episode_lengths.append(int(step_counts[b]))
-            self._episodes_played += int(ending.sum())
-            # Force-terminate truncated games so reset picks them up.
-            if truncated.any():
-                new_states = new_states.replace(
-                    done=jnp.asarray(dones_np | truncated)
-                )
+        step_counts = new_states.step_count
+        truncated = (~dones) & (step_counts >= self.config.MAX_EPISODE_MOVES)
+        ending = dones | truncated
+        flush_mask = pend_active & ending[:, None]
+        flush = {
+            "grid": pend_grid,
+            "other": pend_other,
+            "policy": pend_policy,
+            "ret": pend_return,
+            "mask": flush_mask,
+        }
+        pend_active = pend_active & ~ending[:, None]
+
+        episode = {
+            "ending": ending,
+            "score": new_states.score,
+            "length": step_counts,
+            "start_version": carry.episode_start_version,
+        }
 
         # 8. Reset finished games in place; batch shape never changes.
-        self.states = self.env.reset_where_done_jit(
-            new_states, self._next_key()
+        new_states = new_states.replace(done=ending)
+        reset_states = self.env.reset_where_done(new_states, k_reset)
+        episode_start_version = jnp.where(
+            ending, version, carry.episode_start_version
         )
-        self._move_index += 1
+
+        new_carry = RolloutCarry(
+            env=reset_states,
+            rng=rng,
+            pend_grid=pend_grid,
+            pend_other=pend_other,
+            pend_policy=pend_policy,
+            pend_return=pend_return,
+            pend_discount=pend_discount,
+            pend_active=pend_active,
+            episode_start_version=episode_start_version,
+            move_index=carry.move_index + 1,
+        )
+        outputs = {
+            "mat": mat,
+            "flush": flush,
+            "episode": episode,
+            "sentinel_live": sentinel_live,
+            # Per-move diagnostics (tiny (B,) rows): lets tests validate
+            # the windowed n-step math against an independent reference
+            # without reaching inside the traced computation.
+            "trace": {
+                "root_value": out.root_value,
+                "reward": rewards,
+                "ending": ending,
+            },
+        }
+        return new_carry, outputs
+
+    def _chunk(self, num_moves: int, variables, carry: RolloutCarry, version):
+        """`num_moves` lockstep moves as one scanned computation."""
+        body = functools.partial(self._move_body, variables, version)
+        return jax.lax.scan(body, carry, None, length=num_moves)
+
+    # --- host API ---------------------------------------------------------
+
+    @property
+    def states(self) -> EnvState:
+        """Current (device-resident) batched game states."""
+        return self._carry.env
+
+    def play_chunk(self, num_moves: int | None = None) -> None:
+        """Advance every game `num_moves` moves in ONE jitted dispatch."""
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        version = self.net.weights_version
+        self._min_weights_version = (
+            version
+            if self._min_weights_version is None
+            else min(self._min_weights_version, version)
+        )
+        self._carry, outputs = self._chunk_fn(t)(
+            self.net.variables, self._carry, jnp.int32(version)
+        )
+        host = jax.device_get(outputs)  # the one transfer per chunk
+        self._total_simulations += (
+            t * self.batch_size * self.mcts_config.max_simulations
+        )
+
+        self.last_trace = host["trace"]
+        mat, flush, episode = host["mat"], host["flush"], host["episode"]
+        mmask = mat["mask"]  # (T, B)
+        if mmask.any():
+            self._out.append(
+                (
+                    mat["grid"][mmask],
+                    mat["other"][mmask],
+                    mat["policy"][mmask],
+                    mat["ret"][mmask].astype(np.float32),
+                )
+            )
+        fmask = flush["mask"]  # (T, B, n)
+        if fmask.any():
+            self._out.append(
+                (
+                    flush["grid"][fmask],
+                    flush["other"][fmask],
+                    flush["policy"][fmask],
+                    flush["ret"][fmask].astype(np.float32),
+                )
+            )
+        ending = episode["ending"]  # (T, B)
+        if ending.any():
+            self._episode_scores.extend(
+                episode["score"][ending].astype(float).tolist()
+            )
+            self._episode_lengths.extend(
+                episode["length"][ending].astype(int).tolist()
+            )
+            self._episode_start_versions.extend(
+                episode["start_version"][ending].astype(int).tolist()
+            )
+            self._episodes_played += int(ending.sum())
+        sentinels = int(host["sentinel_live"].sum())
+        if sentinels:
+            logger.warning(
+                "SelfPlay: %d zero-visit sentinel actions on LIVE games "
+                "(clamped to action 0) — root search produced no visits.",
+                sentinels,
+            )
+
+    def play_move(self) -> None:
+        """Advance every game by one move (single-move chunk)."""
+        self.play_chunk(1)
 
     def play_moves(self, num_moves: int) -> SelfPlayResult:
         """Advance all games `num_moves` moves and harvest experiences."""
-        for _ in range(num_moves):
-            self.play_move()
+        self.play_chunk(num_moves)
         return self.harvest()
 
     def harvest(self) -> SelfPlayResult:
@@ -234,8 +356,8 @@ class SelfPlayEngine:
         else:
             c, h, w = self._grid_shape
             grids = np.zeros((0, c, h, w), np.float32)
-            others = np.zeros((0, self._pend_other.shape[-1]), np.float32)
-            policies = np.zeros((0, self._pend_policy.shape[-1]), np.float32)
+            others = np.zeros((0, self._other_dim), np.float32)
+            policies = np.zeros((0, self._action_dim), np.float32)
             values = np.zeros((0,), np.float32)
         result = SelfPlayResult(
             grid=grids,
@@ -244,6 +366,7 @@ class SelfPlayEngine:
             value_target=values,
             episode_scores=self._episode_scores,
             episode_lengths=self._episode_lengths,
+            episode_start_versions=self._episode_start_versions,
             num_episodes=self._episodes_played,
             total_simulations=self._total_simulations,
             trainer_step_at_episode_start=(
@@ -255,6 +378,7 @@ class SelfPlayEngine:
         self._out = []
         self._episode_scores = []
         self._episode_lengths = []
+        self._episode_start_versions = []
         self._episodes_played = 0
         self._total_simulations = 0
         self._min_weights_version = None

@@ -223,9 +223,12 @@ class Trainer:
         per_sample = (
             cfg.POLICY_LOSS_WEIGHT * policy_ce
             + cfg.VALUE_LOSS_WEIGHT * value_ce
-            - cfg.ENTROPY_BONUS_WEIGHT * entropy
         )
-        total = (w * per_sample).mean()
+        # Entropy regularization uses the UNWEIGHTED mean — the reference
+        # is explicit about this ("Use mean entropy, not weighted",
+        # `trainer.py:253-256`); IS weights must not modulate the
+        # regularizer's strength per sample.
+        total = (w * per_sample).mean() - cfg.ENTROPY_BONUS_WEIGHT * entropy.mean()
         aux = {
             "total_loss": total,
             "policy_loss": (w * policy_ce).mean(),

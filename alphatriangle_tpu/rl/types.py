@@ -28,6 +28,10 @@ class SelfPlayResult(BaseModel):
 
     episode_scores: list[float] = []
     episode_lengths: list[int] = []
+    # Weights version each finished episode *started* under — the
+    # per-episode staleness tag (reference `worker.py:136-139`), finer
+    # than the window-level `trainer_step_at_episode_start` below.
+    episode_start_versions: list[int] = []
     num_episodes: int = 0
     total_simulations: int = 0
     # Weight version the producing rollout ran with (staleness tag,

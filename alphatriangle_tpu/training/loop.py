@@ -18,6 +18,7 @@ from enum import Enum
 
 import numpy as np
 
+from ..profiling import ProfileSession
 from ..stats.events import RawMetricEvent
 from ..utils.helpers import format_eta
 from .components import TrainingComponents
@@ -46,6 +47,13 @@ class TrainingLoop:
         self._last_saved_step: int | None = None
         self._last_progress_time = time.monotonic()
         self._last_progress_step = 0
+        # Per-phase timers always run (ns-level overhead); the device
+        # trace + metric export + json dump activate under --profile
+        # (reference `worker.py:99-104`, TrainConfig.PROFILE_WORKERS).
+        self.profile = ProfileSession(
+            enabled=self.cfg.PROFILE_WORKERS,
+            profile_dir=components.persistence_config.get_profile_dir(),
+        )
 
     # --- resume -----------------------------------------------------------
 
@@ -102,8 +110,13 @@ class TrainingLoop:
                 ),
                 RawMetricEvent(
                     name="SelfPlay/Staleness_Steps",
-                    value=c.net.weights_version
-                    - result.trainer_step_at_episode_start,
+                    value=(
+                        c.net.weights_version
+                        - float(np.mean(result.episode_start_versions))
+                        if result.episode_start_versions
+                        else c.net.weights_version
+                        - result.trainer_step_at_episode_start
+                    ),
                     global_step=step,
                 ),
             ]
@@ -117,12 +130,14 @@ class TrainingLoop:
         (reference `loop.py:213-296`).
         """
         c = self.c
-        sample = c.buffer.sample(
-            self.cfg.BATCH_SIZE, current_train_step=self.global_step
-        )
+        with self.profile.phase("sample"):
+            sample = c.buffer.sample(
+                self.cfg.BATCH_SIZE, current_train_step=self.global_step
+            )
         if sample is None:
             return False
-        out = c.trainer.train_step(sample["batch"])
+        with self.profile.phase("train"):
+            out = c.trainer.train_step(sample["batch"])
         if out is None:
             return False
         metrics, td_errors = out
@@ -229,6 +244,7 @@ class TrainingLoop:
         (reference `loop.py:298-416`)."""
         cfg = self.cfg
         status = LoopStatus.COMPLETED
+        iteration = 0
         try:
             while not self.stop_event.is_set():
                 if (
@@ -239,7 +255,10 @@ class TrainingLoop:
                         "Reached MAX_TRAINING_STEPS=%d.", cfg.MAX_TRAINING_STEPS
                     )
                     break
-                added = self._process_rollout()
+                self.profile.on_iteration(iteration)
+                iteration += 1
+                with self.profile.phase("rollout"):
+                    added = self._process_rollout()
                 n_steps = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
                     1, round(added / cfg.BATCH_SIZE)
                 )
@@ -254,7 +273,11 @@ class TrainingLoop:
                     # Cadence check per learner step: iterations can run
                     # several steps, which would hop over multiples of
                     # CHECKPOINT_SAVE_FREQ_STEPS.
-                    self._maybe_checkpoint()
+                    with self.profile.phase("checkpoint"):
+                        self._maybe_checkpoint()
+                if self.cfg.PROFILE_WORKERS:
+                    for name, val in self.profile.timers.metrics().items():
+                        self.c.stats.log_scalar(name, val, self.global_step)
                 self.c.stats.process_and_log(self.global_step)
                 self._log_progress()
         except KeyboardInterrupt:
@@ -265,6 +288,7 @@ class TrainingLoop:
             status = LoopStatus.ERROR
         finally:
             try:
+                self.profile.close()
                 self._maybe_checkpoint(force=True)
                 self.c.checkpoints.wait_until_finished()
                 self.c.stats.force_process_and_log(self.global_step)

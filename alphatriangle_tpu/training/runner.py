@@ -17,6 +17,7 @@ from ..config.persistence_config import PersistenceConfig
 from ..config.train_config import TrainConfig
 from ..logging_config import setup_logging
 from ..stats.persistence import CheckpointManager
+from ..utils.helpers import enforce_platform
 from .loop import LoopStatus, TrainingLoop
 from .setup import setup_training_components
 
@@ -60,6 +61,9 @@ def run_training(
     """Run a full training session; returns a process exit code."""
     setup_logging(log_level)
     train_config = train_config or TrainConfig()
+    # Must precede any backend init (a site hook can override the env
+    # var and point a CPU-intended run at a possibly-wedged TPU).
+    enforce_platform(train_config.DEVICE)
     persistence_config = persistence_config or PersistenceConfig(
         RUN_NAME=train_config.RUN_NAME
     )

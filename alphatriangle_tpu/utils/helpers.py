@@ -1,12 +1,33 @@
 """Small shared helpers (reference: `alphatriangle/utils/helpers.py:12-108`)."""
 
 import logging
+import os
 import random
 
 import jax
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+
+def enforce_platform(device: str = "auto") -> None:
+    """Pin the JAX platform BEFORE any backend initializes.
+
+    `JAX_PLATFORMS=cpu` in the environment is not sufficient on hosts
+    whose accelerator plugin ships a sitecustomize that re-forces the
+    config value at interpreter startup (observed with the axon TPU
+    plugin) — and a wedged TPU then hangs backend init for minutes.
+    Re-asserting at the config layer wins as long as no backend has
+    been created yet. `device="cpu"` forces CPU; `"auto"` honors an
+    explicit `JAX_PLATFORMS=cpu` env request; anything else is a no-op.
+    """
+    want_cpu = device == "cpu" or (
+        device == "auto"
+        and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    )
+    if want_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
 
 
 def get_device(preference: str = "auto") -> jax.Device:

@@ -4,8 +4,8 @@ Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "extra": {...}}
 
 Primary metric: **self-play games/hour**, measured directly (episodes
-completed / wall-clock) with the flagship configuration — default 8x15
-board, conv+residual+transformer net, 64-sim batched MCTS — on one
+completed / wall-clock) with the flagship configuration - default 8x15
+board, conv+residual+transformer net, 64-sim batched MCTS - on one
 chip. `vs_baseline` divides by the BASELINE.json north star (10,000
 games/hour on v4-8 with a 4-layer transformer net); the reference
 itself publishes no numbers (BASELINE.md).
@@ -13,12 +13,24 @@ itself publishes no numbers (BASELINE.md).
 `extra` carries the secondary BASELINE metrics: MCTS leaf-evals/sec
 (per chip) and learner steps/sec on a 256 batch.
 
-Env knobs: BENCH_SMOKE=1 shrinks everything for a fast CPU sanity run;
-BENCH_SECONDS overrides the self-play measurement window.
+Resilience: the accelerator is probed in a SUBPROCESS with a hard
+timeout before this process touches JAX at all - a wedged TPU init
+hangs uninterruptibly in-process (observed >570s in round 2), so a
+watchdog thread cannot recover from it; a child process can simply be
+killed. On probe failure the bench falls back to CPU and STILL emits
+its one JSON line, with `extra.backend` recording what actually ran.
+Any later crash also emits the JSON line (value 0, error recorded).
+
+Env knobs:
+  BENCH_SMOKE=1         shrink everything for a fast CPU sanity run
+  BENCH_SECONDS=N       override the self-play measurement window
+  BENCH_INIT_TIMEOUT=N  accelerator-probe timeout in seconds (default 180)
+  JAX_PLATFORMS=cpu     skip the probe, run straight on CPU
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -27,9 +39,57 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def probe_accelerator(timeout_s: float) -> "str | None":
+    """Initialize JAX in a child process; return its backend name or None.
+
+    The child inherits the ambient environment (including any accelerator
+    plugin sitecustomize), so it exercises exactly the init path this
+    process would take. Timeout or nonzero exit -> None (accelerator sick).
+    """
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench: accelerator probe timed out after {timeout_s:.0f}s")
+        return None
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        log(f"bench: accelerator probe failed rc={r.returncode}: {tail}")
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def resolve_backend() -> "tuple[str, str | None]":
+    """Decide the platform BEFORE importing jax; return (decision, probe_error).
+
+    decision is "default" (let the plugin pick, probe passed) or "cpu".
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return "cpu", None
+    timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
+    t0 = time.time()
+    log(f"bench: probing accelerator init (timeout {timeout_s:.0f}s)...")
+    backend = probe_accelerator(timeout_s)
+    if backend is None:
+        return "cpu", f"accelerator init probe failed/timed out after {time.time() - t0:.0f}s"
+    log(f"bench: probe OK ({backend}, {time.time() - t0:.1f}s)")
+    return "default", None
+
+
+def run_bench(smoke: bool, seconds: float) -> dict:
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from alphatriangle_tpu.config import (
@@ -44,24 +104,34 @@ def main() -> None:
     from alphatriangle_tpu.nn.network import NeuralNetwork
     from alphatriangle_tpu.rl import SelfPlayEngine, Trainer
 
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
-    seconds = float(os.environ.get("BENCH_SECONDS", "8" if smoke else "75"))
     backend = jax.default_backend()
     device = jax.devices()[0]
-    log(f"bench: backend={backend} device={device.device_kind if hasattr(device, 'device_kind') else device}")
+    log(
+        "bench: backend="
+        f"{backend} device={getattr(device, 'device_kind', device)}"
+    )
+
+    # Three scales: smoke (sanity), cpu (a CPU can't push the flagship
+    # load — one flagship chunk is ~30 min of CPU leaf evals — so the
+    # fallback measures a reduced but honest config), flagship (TPU).
+    if smoke:
+        scale, sims, depth, sp_batch, chunk, lbatch = "smoke", 8, 4, 16, 4, 32
+    elif backend == "cpu":
+        scale, sims, depth, sp_batch, chunk, lbatch = "cpu", 16, 8, 64, 4, 128
+    else:
+        scale, sims, depth, sp_batch, chunk, lbatch = "flagship", 64, 8, 512, 16, 256
+    log(f"bench: scale={scale} sims={sims} batch={sp_batch} chunk={chunk}")
 
     env_cfg = EnvConfig()
     model_cfg = ModelConfig(
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
         COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
     )
-    mcts_cfg = AlphaTriangleMCTSConfig(
-        max_simulations=8 if smoke else 64, max_depth=4 if smoke else 8
-    )
-    sp_batch = 16 if smoke else 512
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=sims, max_depth=depth)
     train_cfg = TrainConfig(
         SELF_PLAY_BATCH_SIZE=sp_batch,
-        BATCH_SIZE=32 if smoke else 256,
+        ROLLOUT_CHUNK_MOVES=chunk,
+        BATCH_SIZE=lbatch,
         BUFFER_CAPACITY=10_000,
         MIN_BUFFER_SIZE_TO_TRAIN=1_000,
         MAX_TRAINING_STEPS=1_000,
@@ -71,23 +141,21 @@ def main() -> None:
     env = TriangleEnv(env_cfg)
     extractor = get_feature_extractor(env, model_cfg)
     net = NeuralNetwork(model_cfg, env_cfg, seed=0)
-    engine = SelfPlayEngine(
-        env, extractor, net, mcts_cfg, train_cfg, seed=0
-    )
+    engine = SelfPlayEngine(env, extractor, net, mcts_cfg, train_cfg, seed=0)
 
     # --- self-play games/hour (primary) --------------------------------
-    log("bench: compiling self-play move (first dispatch)...")
+    log("bench: compiling self-play chunk (first dispatch)...")
     t0 = time.time()
-    engine.play_move()
+    engine.play_chunk()
     compile_s = time.time() - t0
-    log(f"bench: first move (compile) {compile_s:.1f}s; measuring {seconds:.0f}s...")
+    log(f"bench: first chunk (compile) {compile_s:.1f}s; measuring {seconds:.0f}s...")
     engine.harvest()  # reset counters after warmup
 
     t0 = time.time()
     moves = 0
     while time.time() - t0 < seconds:
-        engine.play_move()
-        moves += 1
+        engine.play_chunk()
+        moves += chunk
     elapsed = time.time() - t0
     result = engine.harvest()
     episodes = result.num_episodes
@@ -128,15 +196,17 @@ def main() -> None:
     log(f"bench: learner {learner_steps_per_sec:.2f} steps/s (batch {b})")
 
     north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)
-    out = {
+    return {
         "metric": "self_play_games_per_hour",
         "value": round(games_per_hour, 1),
         "unit": "games/hour",
         "vs_baseline": round(games_per_hour / north_star, 4),
         "extra": {
             "backend": backend,
+            "scale": scale,
             "self_play_batch": sp_batch,
             "mcts_simulations": sims,
+            "rollout_chunk_moves": chunk,
             "episodes_completed": episodes,
             "measure_seconds": round(elapsed, 1),
             "mean_episode_length": (
@@ -148,10 +218,47 @@ def main() -> None:
             "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
             "learner_steps_per_sec": round(learner_steps_per_sec, 2),
             "learner_batch": b,
-            "first_move_compile_seconds": round(compile_s, 1),
+            "first_chunk_compile_seconds": round(compile_s, 1),
         },
     }
-    print(json.dumps(out), flush=True)
+
+
+def main() -> None:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    seconds = float(os.environ.get("BENCH_SECONDS", "8" if smoke else "75"))
+
+    decision, probe_error = resolve_backend()
+    if decision == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if decision == "cpu":
+        # Site hooks may force the config value at interpreter start;
+        # re-assert before any backend initializes (conftest.py pattern).
+        jax.config.update("jax_platforms", "cpu")
+        if probe_error:
+            log(f"bench: FALLING BACK TO CPU ({probe_error})")
+
+    try:
+        out = run_bench(smoke, seconds)
+    except Exception as exc:  # always emit the one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        out = {
+            "metric": "self_play_games_per_hour",
+            "value": 0.0,
+            "unit": "games/hour",
+            "vs_baseline": 0.0,
+            "extra": {
+                "error": f"{type(exc).__name__}: {exc}",
+                "probe_error": probe_error,
+            },
+        }
+    if probe_error:
+        out.setdefault("extra", {})["probe_error"] = probe_error
+    emit(out)
 
 
 if __name__ == "__main__":

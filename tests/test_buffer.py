@@ -212,6 +212,63 @@ class TestPersistence:
         full.add_dense(*make_dense(2, seed=5))
         assert full.tree.tree[full.tree._cap2 + 8] == pytest.approx(1.0)
 
+    def test_wrapped_snapshot_restores_chronologically(self):
+        """A wrapped ring's snapshot is in slot order; restore must put
+        the oldest entry at slot 0 so future ring writes overwrite
+        oldest-first, and a capacity shrink must keep the NEWEST rows."""
+        src = ExperienceBuffer(uniform_cfg())  # capacity 20
+        # 28 adds with value = chronological index: ring wraps, slots
+        # hold [20..27, 8..19], pos = 8.
+        for i in range(28):
+            src.add_dense(*make_dense(1, value=float(i)))
+        snap = src.get_state()
+        assert snap["pos"] == 8
+
+        same = ExperienceBuffer(uniform_cfg())
+        same.set_state(snap)
+        np.testing.assert_array_equal(
+            same._storage["value_target"][:20], np.arange(8, 28, dtype=np.float32)
+        )
+        assert same._pos == 0  # full: next write lands on the oldest (8)
+        same.add_dense(*make_dense(1, value=99.0))
+        assert same._storage["value_target"][0] == 99.0
+        assert same._storage["value_target"][1] == 9.0  # second-oldest intact
+
+        shrunk = ExperienceBuffer(uniform_cfg(BUFFER_CAPACITY=10))
+        shrunk.set_state(snap)
+        assert len(shrunk) == 10
+        np.testing.assert_array_equal(
+            shrunk._storage["value_target"][:10],
+            np.arange(18, 28, dtype=np.float32),  # newest 10 kept
+        )
+        assert shrunk._pos == 0
+
+        grown = ExperienceBuffer(uniform_cfg(BUFFER_CAPACITY=40))
+        grown.set_state(snap)
+        assert len(grown) == 20
+        assert grown._pos == 20  # next write appends, not overwrites
+        np.testing.assert_array_equal(
+            grown._storage["value_target"][:20], np.arange(8, 28, dtype=np.float32)
+        )
+
+    def test_wrapped_per_snapshot_priorities_follow_rows(self):
+        src = ExperienceBuffer(per_cfg())  # capacity 20
+        for i in range(25):
+            src.add_dense(*make_dense(1, value=float(i)))
+        # Priority = value of the row in each slot, so we can track rows.
+        vals = src._storage["value_target"][:20].astype(np.float64)
+        src.update_priorities(np.arange(20), vals)  # p = (|v|+eps)^alpha
+        snap = src.get_state()
+
+        dst = ExperienceBuffer(per_cfg())
+        dst.set_state(snap)
+        leaves = dst.tree.tree[dst.tree._cap2 : dst.tree._cap2 + 20]
+        expect = (
+            np.abs(dst._storage["value_target"][:20].astype(np.float64))
+            + dst.per_epsilon
+        ) ** dst.alpha
+        np.testing.assert_allclose(leaves, expect, rtol=1e-6)
+
 
 class TestSelfPlayResult:
     def test_valid_rows_kept_invalid_dropped(self):

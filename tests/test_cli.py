@@ -1,0 +1,116 @@
+"""CLI tests (reference `cli.py:141-208` override plumbing).
+
+The train command's end-to-end path is covered by
+tests/test_training_loop.py through `run_training`; here we pin that
+CLI flags land in the right config fields, and that the auxiliary
+commands work without a training run.
+"""
+
+import json
+
+import pytest
+
+from alphatriangle_tpu import cli
+
+
+class TestTrainOverrides:
+    def _capture(self, monkeypatch):
+        captured = {}
+
+        def fake_run_training(**kwargs):
+            captured.update(kwargs)
+            return 0
+
+        monkeypatch.setattr(
+            "alphatriangle_tpu.training.runner.run_training", fake_run_training
+        )
+        return captured
+
+    def test_flags_map_to_config_fields(self, monkeypatch):
+        captured = self._capture(monkeypatch)
+        rc = cli.main(
+            [
+                "train",
+                "--run-name", "cli_run",
+                "--seed", "123",
+                "--max-steps", "20",
+                "--self-play-batch", "8",
+                "--batch-size", "16",
+                "--buffer-capacity", "500",
+                "--min-buffer", "32",
+                "--rollout-chunk", "2",
+                "--no-per",
+                "--no-auto-resume",
+                "--profile",
+                "--root-dir", "/tmp/cli_test_root",
+                "--no-tensorboard",
+                "--log-level", "WARNING",
+            ]
+        )
+        assert rc == 0
+        tc = captured["train_config"]
+        assert tc.RUN_NAME == "cli_run"
+        assert tc.RANDOM_SEED == 123
+        assert tc.MAX_TRAINING_STEPS == 20
+        assert tc.SELF_PLAY_BATCH_SIZE == 8
+        assert tc.BATCH_SIZE == 16
+        assert tc.BUFFER_CAPACITY == 500
+        assert tc.MIN_BUFFER_SIZE_TO_TRAIN == 32
+        assert tc.ROLLOUT_CHUNK_MOVES == 2
+        assert tc.USE_PER is False
+        assert tc.AUTO_RESUME_LATEST is False
+        assert tc.PROFILE_WORKERS is True
+        pc = captured["persistence_config"]
+        assert pc.ROOT_DATA_DIR == "/tmp/cli_test_root"
+        assert pc.RUN_NAME == "cli_run"
+        assert captured["use_tensorboard"] is False
+        assert captured["log_level"] == "WARNING"
+
+    def test_defaults_leave_config_alone(self, monkeypatch):
+        captured = self._capture(monkeypatch)
+        assert cli.main(["train", "--run-name", "r"]) == 0
+        tc = captured["train_config"]
+        assert tc.USE_PER is True
+        assert tc.AUTO_RESUME_LATEST is True
+        assert captured["persistence_config"] is None
+
+    def test_invalid_override_fails_fast(self, monkeypatch):
+        self._capture(monkeypatch)
+        with pytest.raises(Exception):
+            # BATCH_SIZE > BUFFER_CAPACITY violates config validation.
+            cli.main(
+                ["train", "--batch-size", "64", "--buffer-capacity", "32"]
+            )
+
+
+class TestAuxCommands:
+    def test_devices(self, capsys):
+        assert cli.main(["devices"]) == 0
+        out = capsys.readouterr().out
+        assert "backend: cpu" in out
+
+    def test_analyze_phase_timers(self, tmp_path, capsys):
+        (tmp_path / "phase_timers.json").write_text(
+            json.dumps(
+                {
+                    "rollout": {
+                        "total_seconds": 12.5, "count": 10, "mean_ms": 1250.0
+                    },
+                    "train": {
+                        "total_seconds": 2.0, "count": 40, "mean_ms": 50.0
+                    },
+                }
+            )
+        )
+        assert cli.main(["analyze", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "rollout" in out and "1250.00" in out
+        # Sorted by total time: rollout line precedes train.
+        assert out.index("rollout") < out.index("train")
+
+    def test_analyze_missing_dir(self, tmp_path, capsys):
+        assert cli.main(["analyze", str(tmp_path / "nope")]) == 1
+
+    def test_requires_subcommand(self):
+        with pytest.raises(SystemExit):
+            cli.main([])

@@ -1,0 +1,81 @@
+"""Profiling subsystem tests (reference `worker.py:549-566` +
+`analyze_profiles.py:41-78` equivalents)."""
+
+import json
+import time
+
+from alphatriangle_tpu.profiling import PhaseTimers, ProfileSession
+
+
+class TestPhaseTimers:
+    def test_accumulates_and_reports(self):
+        t = PhaseTimers()
+        for _ in range(3):
+            with t.phase("work"):
+                time.sleep(0.002)
+        with t.phase("other"):
+            pass
+        m = t.metrics()
+        assert m["Profile/work_ms"] >= 2.0
+        s = t.summary()
+        assert s["work"]["count"] == 3
+        assert s["other"]["count"] == 1
+
+    def test_dump(self, tmp_path):
+        t = PhaseTimers()
+        with t.phase("x"):
+            pass
+        t.dump(tmp_path / "sub" / "phase_timers.json")
+        data = json.loads((tmp_path / "sub" / "phase_timers.json").read_text())
+        assert data["x"]["count"] == 1
+
+    def test_exception_safe(self):
+        t = PhaseTimers()
+        try:
+            with t.phase("boom"):
+                raise RuntimeError
+        except RuntimeError:
+            pass
+        assert t.summary()["boom"]["count"] == 1
+
+
+class TestProfileSession:
+    def test_disabled_is_inert(self, tmp_path):
+        s = ProfileSession(enabled=False, profile_dir=tmp_path / "p")
+        s.on_iteration(0)
+        s.on_iteration(1)
+        with s.phase("rollout"):
+            pass
+        s.close()
+        assert not (tmp_path / "p").exists()
+
+    def test_trace_window_and_dump(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        s = ProfileSession(
+            enabled=True,
+            profile_dir=tmp_path / "p",
+            trace_start=1,
+            trace_stop=2,
+        )
+        for i in range(3):
+            s.on_iteration(i)
+            with s.phase("rollout"):
+                jnp.square(jnp.arange(8.0)).block_until_ready()
+        s.close()
+        assert (tmp_path / "p" / "phase_timers.json").exists()
+        # jax.profiler writes an xplane trace under plugins/profile/.
+        traces = list((tmp_path / "p").glob("**/*.xplane.pb"))
+        assert traces, "no device trace written"
+        del jax
+
+    def test_close_stops_open_trace(self, tmp_path):
+        s = ProfileSession(
+            enabled=True, profile_dir=tmp_path / "p", trace_start=0,
+            trace_stop=99,
+        )
+        s.on_iteration(0)  # starts trace; stop never reached
+        s.close()  # must stop it and dump timers
+        assert (tmp_path / "p" / "phase_timers.json").exists()
+        assert list((tmp_path / "p").glob("**/*.xplane.pb"))

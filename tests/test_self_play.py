@@ -103,41 +103,25 @@ class TestBasics:
 
 class TestNStepMath:
     def test_window_matches_reference_deque(self, world):
-        """Record the engine's own per-move (reward, root_value, ending)
-        traces and replay them through a straightforward per-game deque;
-        emitted value-target multisets must match exactly."""
+        """Replay the engine's own per-move (reward, root_value, ending)
+        trace through a straightforward per-game deque; emitted
+        value-target multisets must match exactly."""
         engine, tc = make_engine(world)
         n, gamma = tc.N_STEP_RETURNS, tc.GAMMA
         B = engine.batch_size
 
-        trace = []
-        orig_search = engine.mcts.search
-        orig_step = engine.env.step_batch
-
-        def spy_search(variables, states, rng):
-            out = orig_search(variables, states, rng)
-            trace.append({"root_value": np.asarray(out.root_value)})
-            return out
-
-        def spy_step(states, actions):
-            new_states, rewards, dones = orig_step(states, actions)
-            trace[-1]["reward"] = np.asarray(rewards)
-            step_counts = np.asarray(new_states.step_count)
-            dn = np.asarray(dones)
-            trace[-1]["ending"] = dn | (
-                (~dn) & (step_counts >= tc.MAX_EPISODE_MOVES)
-            )
-            return new_states, rewards, dones
-
-        engine.mcts.search = spy_search
-        engine.env.step_batch = spy_step
-        try:
-            M = 14
-            result = engine.play_moves(M)
-        finally:
-            # env is a module-shared fixture; never leak the spy.
-            engine.env.step_batch = orig_step
-            engine.mcts.search = orig_search
+        M = 14
+        result = engine.play_moves(M)
+        tr = engine.last_trace
+        assert tr is not None and tr["reward"].shape == (M, B)
+        trace = [
+            {
+                "root_value": tr["root_value"][t],
+                "reward": tr["reward"][t],
+                "ending": tr["ending"][t],
+            }
+            for t in range(M)
+        ]
 
         # Reference implementation: per-game deque of pending items.
         expected: list[float] = []

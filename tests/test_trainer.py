@@ -121,13 +121,28 @@ class TestTrainStep:
         trainer = Trainer(network, tiny_train_config)
         assert trainer.train_step(make_batch(0)) is None
 
-    def test_zero_weights_zero_grads(self, network, tiny_train_config):
+    def test_zero_weights_leave_only_entropy_grads(
+        self, network, tiny_train_config
+    ):
+        """IS weights gate the policy/value losses but NOT the entropy
+        regularizer, which the reference keeps as an unweighted mean
+        (`trainer.py:253-256`)."""
         trainer = Trainer(network, tiny_train_config)
         out = trainer.train_step(
             make_batch(weights=np.zeros(B, dtype=np.float32))
         )
         assert out is not None
-        assert out[0]["grad_norm"] == pytest.approx(0.0, abs=1e-12)
+        metrics = out[0]
+        # Weighted terms vanish...
+        assert metrics["policy_loss"] == pytest.approx(0.0, abs=1e-12)
+        assert metrics["value_loss"] == pytest.approx(0.0, abs=1e-12)
+        # ...but the entropy bonus still produces a gradient.
+        ent_w = tiny_train_config.ENTROPY_BONUS_WEIGHT
+        assert metrics["total_loss"] == pytest.approx(
+            -ent_w * metrics["entropy"], abs=1e-9
+        )
+        if ent_w > 0:
+            assert metrics["grad_norm"] > 0.0
 
     def test_lr_follows_schedule(self, network, tiny_train_config):
         trainer = Trainer(network, tiny_train_config)

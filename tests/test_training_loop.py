@@ -77,7 +77,10 @@ class TestLoop:
         # Metrics flowed through the collector.
         assert c.stats.latest("Loss/total_loss") is not None
         assert c.stats.latest("Buffer/Size") > 0
-        assert c.stats.latest("PER/Beta") == pytest.approx(1.0)
+        # The stats value is a per-tick mean and an iteration can cover
+        # several learner steps; the anneal endpoint itself must be exact.
+        assert c.stats.latest("PER/Beta") == pytest.approx(1.0, abs=0.1)
+        assert c.buffer.beta(loop.global_step) == pytest.approx(1.0)
         # Checkpoints: cadence (step 4) + final (step 8).
         assert c.checkpoints.latest_step() == 8
         steps = sorted(
