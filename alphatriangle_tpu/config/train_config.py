@@ -47,6 +47,23 @@ class TrainConfig(BaseModel):
     # equivalent of the reference's free-running async learner.
     LEARNER_STEPS_PER_ROLLOUT: int | None = Field(default=None, ge=1)
 
+    # --- Overlapped (async) orchestration ---
+    # Run self-play in a producer thread feeding a bounded queue while
+    # the learner consumes at REPLAY_RATIO; host work (harvest
+    # compaction, PER sampling, priority updates) then overlaps with
+    # device compute instead of serializing with it (the reference's
+    # async producer/consumer topology, `training/loop.py:298-416`,
+    # re-expressed for one process).
+    ASYNC_ROLLOUTS: bool = Field(default=False)
+    # Target learner consumption rate: samples consumed per experience
+    # produced (steps * BATCH_SIZE / experiences). The synchronous
+    # loop's implicit `added/BATCH_SIZE` matching corresponds to 1.0;
+    # here it is an explicit, measured knob.
+    REPLAY_RATIO: float = Field(default=1.0, gt=0)
+    # Bounded harvest queue between producer and learner (backpressure:
+    # the producer blocks when the learner falls this many chunks behind).
+    ROLLOUT_QUEUE_MAX: int = Field(default=4, ge=1)
+
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)
     BUFFER_CAPACITY: int = Field(default=250_000, ge=1)

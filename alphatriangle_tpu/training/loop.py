@@ -1,10 +1,21 @@
 """The training loop (reference `training/loop.py:23-416`).
 
-Synchronous producer/consumer over device-batched self-play: each
-iteration plays a rollout chunk (`ROLLOUT_CHUNK_MOVES` moves of all
-`SELF_PLAY_BATCH_SIZE` games), folds the harvest into the replay
-buffer, then runs learner steps — auto-matched to the production rate
-unless `LEARNER_STEPS_PER_ROLLOUT` pins it. Cadences are parity knobs:
+Two orchestration modes over device-batched self-play:
+
+- **Synchronous** (default): each iteration plays a rollout chunk
+  (`ROLLOUT_CHUNK_MOVES` moves of all `SELF_PLAY_BATCH_SIZE` games),
+  folds the harvest into the replay buffer, then runs learner steps —
+  auto-matched to the production rate unless
+  `LEARNER_STEPS_PER_ROLLOUT` pins it.
+- **Overlapped** (`ASYNC_ROLLOUTS=True`): a producer thread plays
+  chunks into a bounded queue while the main thread folds harvests and
+  runs learner steps gated by an explicit `REPLAY_RATIO` — the
+  reference's async producer/consumer topology
+  (`training/loop.py:298-416`, `worker_manager.py:106-167`)
+  re-expressed for one process; queue depth and achieved replay ratio
+  are exported as gauges.
+
+Cadences are parity knobs:
 weight sync every `WORKER_UPDATE_FREQ_STEPS` learner steps
 (`loop.py:271-287`), checkpoint every `CHECKPOINT_SAVE_FREQ_STEPS`
 (`loop.py:333-339`), buffer spill every `BUFFER_SAVE_FREQ_STEPS`
@@ -12,6 +23,7 @@ weight sync every `WORKER_UPDATE_FREQ_STEPS` learner steps
 """
 
 import logging
+import queue
 import threading
 import time
 from enum import Enum
@@ -44,6 +56,9 @@ class TrainingLoop:
         self.episodes_played = 0
         self.total_simulations = 0
         self.weight_updates = 0
+        self.experiences_added = 0  # this run (resume-independent)
+        self._steps_this_run = 0
+        self._producer_error: BaseException | None = None
         self._last_saved_step: int | None = None
         self._last_progress_time = time.monotonic()
         self._last_progress_step = 0
@@ -70,8 +85,12 @@ class TrainingLoop:
 
     def _process_rollout(self) -> int:
         """One rollout chunk -> buffer. Returns experiences added."""
+        result = self.c.self_play.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
+        return self._fold_result(result)
+
+    def _fold_result(self, result) -> int:
+        """Fold one self-play harvest into the buffer + metrics."""
         c = self.c
-        result = c.self_play.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
         c.buffer.add_dense(
             result.grid,
             result.other_features,
@@ -121,6 +140,7 @@ class TrainingLoop:
                 ),
             ]
         c.stats.log_batch_events(events)
+        self.experiences_added += result.num_experiences
         return result.num_experiences
 
     def _run_training_step(self) -> bool:
@@ -143,6 +163,7 @@ class TrainingLoop:
         metrics, td_errors = out
         c.buffer.update_priorities(sample["indices"], td_errors)
         self.global_step = c.trainer.global_step
+        self._steps_this_run += 1
 
         step = self.global_step
         events = [
@@ -239,47 +260,19 @@ class TrainingLoop:
 
     # --- main loop --------------------------------------------------------
 
+    def _max_steps_reached(self) -> bool:
+        max_steps = self.cfg.MAX_TRAINING_STEPS
+        return max_steps is not None and self.global_step >= max_steps
+
     def run(self) -> LoopStatus:
         """Run until MAX_TRAINING_STEPS / stop / error
         (reference `loop.py:298-416`)."""
-        cfg = self.cfg
         status = LoopStatus.COMPLETED
-        iteration = 0
         try:
-            while not self.stop_event.is_set():
-                if (
-                    cfg.MAX_TRAINING_STEPS is not None
-                    and self.global_step >= cfg.MAX_TRAINING_STEPS
-                ):
-                    logger.info(
-                        "Reached MAX_TRAINING_STEPS=%d.", cfg.MAX_TRAINING_STEPS
-                    )
-                    break
-                self.profile.on_iteration(iteration)
-                iteration += 1
-                with self.profile.phase("rollout"):
-                    added = self._process_rollout()
-                n_steps = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
-                    1, round(added / cfg.BATCH_SIZE)
-                )
-                for _ in range(n_steps):
-                    if (
-                        cfg.MAX_TRAINING_STEPS is not None
-                        and self.global_step >= cfg.MAX_TRAINING_STEPS
-                    ):
-                        break
-                    if not self._run_training_step():
-                        break
-                    # Cadence check per learner step: iterations can run
-                    # several steps, which would hop over multiples of
-                    # CHECKPOINT_SAVE_FREQ_STEPS.
-                    with self.profile.phase("checkpoint"):
-                        self._maybe_checkpoint()
-                if self.cfg.PROFILE_WORKERS:
-                    for name, val in self.profile.timers.metrics().items():
-                        self.c.stats.log_scalar(name, val, self.global_step)
-                self.c.stats.process_and_log(self.global_step)
-                self._log_progress()
+            if self.cfg.ASYNC_ROLLOUTS:
+                self._run_async()
+            else:
+                self._run_sync()
         except KeyboardInterrupt:
             logger.warning("Interrupted; saving final state.")
             status = LoopStatus.STOPPED
@@ -287,6 +280,7 @@ class TrainingLoop:
             logger.exception("Training loop error.")
             status = LoopStatus.ERROR
         finally:
+            self.stop_event.set()
             try:
                 self.profile.close()
                 self._maybe_checkpoint(force=True)
@@ -296,3 +290,167 @@ class TrainingLoop:
                 logger.exception("Final save failed.")
                 status = LoopStatus.ERROR
         return status
+
+    def _run_sync(self) -> None:
+        cfg = self.cfg
+        iteration = 0
+        while not self.stop_event.is_set():
+            if self._max_steps_reached():
+                logger.info(
+                    "Reached MAX_TRAINING_STEPS=%d.", cfg.MAX_TRAINING_STEPS
+                )
+                break
+            self.profile.on_iteration(iteration)
+            iteration += 1
+            with self.profile.phase("rollout"):
+                added = self._process_rollout()
+            n_steps = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
+                1, round(added / cfg.BATCH_SIZE)
+            )
+            for _ in range(n_steps):
+                if self._max_steps_reached():
+                    break
+                if not self._run_training_step():
+                    break
+                # Cadence check per learner step: iterations can run
+                # several steps, which would hop over multiples of
+                # CHECKPOINT_SAVE_FREQ_STEPS.
+                with self.profile.phase("checkpoint"):
+                    self._maybe_checkpoint()
+            self._iteration_tail()
+
+    # --- overlapped producer/consumer ------------------------------------
+
+    def _producer_loop(self, out: "queue.Queue") -> None:
+        """Self-play producer: play chunks, enqueue harvests.
+
+        Runs in a daemon thread. JAX dispatch is thread-safe; device
+        compute serializes with the learner's, but the host-side work
+        on both sides (harvest compaction here, PER sampling/priority
+        updates there) now overlaps with it. Weight syncs are picked up
+        at the next chunk via `net.variables` (no broadcast; replaces
+        reference `worker_manager.py:169-209`).
+        """
+        try:
+            while not self.stop_event.is_set():
+                # Timed as "rollout" here — in async mode the producer
+                # owns the self-play device time; the consumer's queue
+                # drain is timed separately as "fold".
+                with self.profile.phase("rollout"):
+                    result = self.c.self_play.play_moves(
+                        self.cfg.ROLLOUT_CHUNK_MOVES
+                    )
+                while not self.stop_event.is_set():
+                    try:
+                        out.put(result, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as exc:  # surface in the consumer thread
+            self._producer_error = exc
+            self.stop_event.set()
+
+    def _learner_steps_allowed(self) -> int:
+        """Replay-ratio gate: steps the learner may run this instant.
+
+        REPLAY_RATIO = samples consumed per experience produced, i.e.
+        allowed steps = produced * ratio / BATCH_SIZE. Counted within
+        this run so a resumed `global_step` doesn't starve the gate.
+        """
+        target = (
+            self.experiences_added * self.cfg.REPLAY_RATIO / self.cfg.BATCH_SIZE
+        )
+        return max(0, int(target) - self._steps_this_run)
+
+    def _run_async(self) -> None:
+        cfg = self.cfg
+        harvests: "queue.Queue" = queue.Queue(maxsize=cfg.ROLLOUT_QUEUE_MAX)
+        producer = threading.Thread(
+            target=self._producer_loop,
+            args=(harvests,),
+            name="self-play-producer",
+            daemon=True,
+        )
+        producer.start()
+        iteration = 0
+        try:
+            while not self.stop_event.is_set():
+                if self._max_steps_reached():
+                    logger.info(
+                        "Reached MAX_TRAINING_STEPS=%d.",
+                        cfg.MAX_TRAINING_STEPS,
+                    )
+                    break
+                self.profile.on_iteration(iteration)
+                iteration += 1
+                # Drain everything available; block briefly only when
+                # there is no learner work to do either.
+                folded = 0
+                with self.profile.phase("fold"):
+                    while True:
+                        try:
+                            self._fold_result(harvests.get_nowait())
+                            folded += 1
+                        except queue.Empty:
+                            break
+                    if (
+                        folded == 0
+                        and not self.stop_event.is_set()
+                        and (
+                            self._learner_steps_allowed() == 0
+                            or not self.c.buffer.is_ready()
+                        )
+                    ):
+                        try:
+                            self._fold_result(harvests.get(timeout=0.5))
+                            folded += 1
+                        except queue.Empty:
+                            pass
+                steps_ran = 0
+                for _ in range(self._learner_steps_allowed()):
+                    if self._max_steps_reached() or self.stop_event.is_set():
+                        break
+                    if not self._run_training_step():
+                        break
+                    steps_ran += 1
+                    with self.profile.phase("checkpoint"):
+                        self._maybe_checkpoint()
+                if folded == 0 and steps_ran == 0:
+                    # Gate open but the buffer can't produce a batch yet
+                    # (or the trainer rejected one): don't busy-spin.
+                    time.sleep(0.05)
+                self.c.stats.log_scalar(
+                    "System/Rollout_Queue_Depth",
+                    harvests.qsize(),
+                    self.global_step,
+                )
+                if self.experiences_added:
+                    self.c.stats.log_scalar(
+                        "System/Replay_Ratio_Actual",
+                        self._steps_this_run
+                        * cfg.BATCH_SIZE
+                        / self.experiences_added,
+                        self.global_step,
+                    )
+                self._iteration_tail()
+        finally:
+            self.stop_event.set()
+            producer.join(timeout=30.0)
+            if producer.is_alive():
+                logger.warning("Self-play producer did not join within 30s.")
+            # Fold any harvests still queued so the final checkpoint /
+            # buffer spill includes everything that was actually played.
+            while True:
+                try:
+                    self._fold_result(harvests.get_nowait())
+                except queue.Empty:
+                    break
+            if self._producer_error is not None:
+                raise self._producer_error
+
+    def _iteration_tail(self) -> None:
+        if self.cfg.PROFILE_WORKERS:
+            for name, val in self.profile.timers.metrics().items():
+                self.c.stats.log_scalar(name, val, self.global_step)
+        self.c.stats.process_and_log(self.global_step)
+        self._log_progress()
