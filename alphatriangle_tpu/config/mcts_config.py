@@ -3,10 +3,11 @@
 Parity with `trimcts.SearchConfiguration` as mirrored by the reference's
 `AlphaTriangleMCTSConfig` (`alphatriangle/config/mcts_config.py:10-77`).
 
-The TPU search evaluates one leaf per parallel game per simulation, so
 `mcts_batch_size` (the reference's C++ leaf-collection size,
-`mcts_config.py:57-62`) is kept for config parity but the effective
-MXU batch is SELF_PLAY_BATCH_SIZE games wide.
+`mcts_config.py:57-62`) maps to the TPU search's *wave size*: the
+number of simulations whose leaves are collected in parallel per tree
+before one fused network evaluation. The effective MXU batch per eval
+is SELF_PLAY_BATCH_SIZE games x mcts_batch_size wave members.
 """
 
 import logging
@@ -24,8 +25,17 @@ class AlphaTriangleMCTSConfig(BaseModel):
     dirichlet_alpha: float = Field(default=0.3, ge=0)
     dirichlet_epsilon: float = Field(default=0.25, ge=0, le=1.0)
     discount: float = Field(default=1.0, ge=0, le=1.0)
-    # Parity knob (see module docstring); not a TPU batching control.
+    # Wave size: simulations selected/evaluated in parallel per tree
+    # (the reference's leaf-collection batch; see module docstring).
+    # Clamped at runtime to the largest divisor of max_simulations.
+    # Default matches the reference (`mcts_config.py:14`).
     mcts_batch_size: int = Field(default=32, gt=0)
+    # Gumbel perturbation scale applied to PUCT scores per wave member
+    # during parallel descent, so the wave's descents diverge without
+    # sequential virtual-loss bookkeeping. 0 disables (wave members
+    # then collapse onto one leaf; the duplicate shows up in
+    # `SearchOutput.wasted_slots`).
+    wave_noise_scale: float = Field(default=0.25, ge=0)
 
     @model_validator(mode="after")
     def _warn_depth(self) -> "AlphaTriangleMCTSConfig":

@@ -1,30 +1,35 @@
-"""Batched pure-functional triangle puzzle engine.
+"""Vectorized triangle-puzzle engine on packed bitboards.
 
-TPU-native replacement for the reference's per-process C++
-`trianglengin.GameState` (surface at
-`alphatriangle/rl/self_play/worker.py:190-377`): game state is a
-struct-of-arrays pytree, and `reset` / `step` / `valid_action_mask` are
-pure jittable functions, vmappable across a whole batch of games so
-self-play steps thousands of boards per device dispatch.
+Functional equivalent of the unvendored C++ `trianglengin` engine as
+observed through the reference (`alphatriangle/rl/self_play/worker.py:
+190-378`, `features/extractor.py:25-66`, `tests/conftest.py:34-41`):
+shape slots, placement legality on the up/down triangle lattice with
+death cells, simultaneous maximal-line clearing with rewards, hand
+refill, and termination when nothing fits.
 
-Semantics (behavior contract, pinned by tests/test_env.py):
-- Action encoding: `slot * ROWS * COLS + r * COLS + c`
-  (reference: `alphatriangle/nn/model.py:122-125`).
-- A placement is valid iff the slot holds a shape and every triangle of
-  the shape lands in-bounds on a playable, unoccupied cell of matching
-  orientation (up/down parity).
-- After placement every full line (geometry.build_line_masks) clears
-  simultaneously; reward = placed * REWARD_PER_PLACED_TRIANGLE +
-  cleared * REWARD_PER_CLEARED_TRIANGLE, both also added to the score.
-- The consumed slot empties; when all slots are empty the hand refills
-  with NUM_SHAPE_SLOTS uniform draws from the shape bank.
-- The game ends (PENALTY_GAME_OVER added to reward, not score) when no
-  remaining shape has a valid placement. Stepping an invalid action
-  ends the game the same way. Stepping a finished game is a no-op.
+TPU-first design:
+- The (R, C) occupancy grid is packed into `NW = ceil(R*C/32)` uint32
+  words (a bitboard). Placement legality is a bitwise AND of the board
+  against a precomputed per-(shape, origin) footprint table; line
+  clears are word masks + popcount. The engine's hot ops are therefore
+  dense 32-bit integer vector ops — no boolean stencil gathers, no
+  sub-word layouts, nothing XLA lowers to scalar loops.
+- Geometrically impossible placements (out of bounds, parity mismatch,
+  death overlap) are folded into the table as a sentinel word that is
+  always blocked, so legality needs no separate predicate table.
+- Everything is a pure function over an `EnvState` pytree; batching is
+  `jax.vmap`, persistence is trivial, and the whole transition fuses
+  into the surrounding search/rollout programs under `jit`.
+- The color grid (parity API `get_grid_data_np`, reference
+  `features/extractor.py:28-31`) stays a dense (R, C) int8 plane — it
+  is cold data touched once per step, not per legality probe.
 """
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..config.env_config import EnvConfig
@@ -36,7 +41,7 @@ from .shapes import ShapeBank, build_shape_bank
 class EnvState:
     """One game's state (add a leading batch dim via vmap)."""
 
-    occupied: jax.Array  # (R, C) bool
+    occupied: jax.Array  # (NW,) uint32 packed occupancy bitboard
     color: jax.Array  # (R, C) int8; -1 where empty
     shape_idx: jax.Array  # (SLOTS,) int32 into the bank; -1 = consumed
     shape_color: jax.Array  # (SLOTS,) int8
@@ -45,6 +50,77 @@ class EnvState:
     done: jax.Array  # () bool
     last_cleared: jax.Array  # () int32 triangles cleared by the last step
     key: jax.Array  # PRNG key driving shape refills
+
+
+class _BitTables(NamedTuple):
+    """Precomputed bitboard tables (NumPy; uploaded once as constants)."""
+
+    footprint_ext: np.ndarray  # (S, R*C, NW+1) uint32; word NW = blocked flag
+    line_words: np.ndarray  # (L, NW) uint32
+    death_words: np.ndarray  # (NW,) uint32
+    cell_word: np.ndarray  # (R*C,) int32
+    cell_bit: np.ndarray  # (R*C,) uint32
+
+
+def _pack_np(grid: np.ndarray, nw: int) -> np.ndarray:
+    """(R, C) bool -> (NW,) uint32 (host-side)."""
+    flat = np.asarray(grid, dtype=bool).reshape(-1)
+    words = np.zeros(nw, dtype=np.uint32)
+    for cell in np.flatnonzero(flat):
+        words[cell // 32] |= np.uint32(1) << np.uint32(cell % 32)
+    return words
+
+
+def _build_bit_tables(
+    cfg: EnvConfig, bank: ShapeBank, geometry: EnvGeometry
+) -> _BitTables:
+    rows, cols = cfg.ROWS, cfg.COLS
+    cells = rows * cols
+    nw = (cells + 31) // 32
+    death_flat = geometry.death.reshape(-1)
+
+    fp = np.zeros((bank.n_shapes, cells, nw + 1), dtype=np.uint32)
+    for s in range(bank.n_shapes):
+        for origin in range(cells):
+            r, c = divmod(origin, cols)
+            words = np.zeros(nw + 1, dtype=np.uint32)
+            ok = True
+            for t in range(bank.max_tris):
+                if not bank.tri_valid[s, t]:
+                    continue
+                tr = r + int(bank.tri_r[s, t])
+                tc = c + int(bank.tri_c[s, t])
+                if not (0 <= tr < rows and 0 <= tc < cols):
+                    ok = False
+                    break
+                # Parity: the cell's up/down-ness must match the
+                # shape triangle's (translation must preserve parity).
+                if ((tr + tc) % 2 == 0) != bool(bank.tri_up[s, t]):
+                    ok = False
+                    break
+                cell = tr * cols + tc
+                if death_flat[cell]:
+                    ok = False
+                    break
+                words[cell // 32] |= np.uint32(1) << np.uint32(cell % 32)
+            if not ok:
+                # Sentinel: word NW of the board is all-ones, so this
+                # placement always collides.
+                words[:] = 0
+                words[nw] = 1
+            fp[s, origin] = words
+
+    line_words = np.stack(
+        [_pack_np(m, nw) for m in geometry.line_masks]
+    ) if geometry.n_lines else np.zeros((0, nw), np.uint32)
+
+    return _BitTables(
+        footprint_ext=fp,
+        line_words=line_words,
+        death_words=_pack_np(geometry.death, nw),
+        cell_word=(np.arange(cells) // 32).astype(np.int32),
+        cell_bit=(np.arange(cells) % 32).astype(np.uint32),
+    )
 
 
 class TriangleEnv:
@@ -61,19 +137,21 @@ class TriangleEnv:
         self.rows, self.cols = cfg.ROWS, cfg.COLS
         self.num_slots = cfg.NUM_SHAPE_SLOTS
         self.action_dim = cfg.action_dim
+        self.cells = self.rows * self.cols
+        self.num_words = (self.cells + 31) // 32
 
-        # Device-side static geometry (XLA embeds these as constants).
+        tables = _build_bit_tables(cfg, self.bank, self.geometry)
+        self._tables_np = tables
+        # Device-side static tables (XLA embeds these as constants).
+        self._fp_ext = jnp.asarray(tables.footprint_ext)
+        self._line_words = jnp.asarray(tables.line_words)
+        self._cell_word = jnp.asarray(tables.cell_word)
+        self._cell_bit = jnp.asarray(tables.cell_bit)
+        self._ones_word = jnp.asarray([0xFFFFFFFF], dtype=jnp.uint32)
         self._tri_r = jnp.asarray(self.bank.tri_r)
         self._tri_c = jnp.asarray(self.bank.tri_c)
-        self._tri_up = jnp.asarray(self.bank.tri_up)
         self._tri_valid = jnp.asarray(self.bank.tri_valid)
         self._n_tris = jnp.asarray(self.bank.n_tris)
-        self._death = jnp.asarray(self.geometry.death)
-        self._line_masks = jnp.asarray(self.geometry.line_masks)
-        rr, cc = jnp.meshgrid(
-            jnp.arange(self.rows), jnp.arange(self.cols), indexing="ij"
-        )
-        self._rr, self._cc = rr, cc
 
         # Jitted batched entry points (leading batch dim).
         self.reset_batch = jax.jit(jax.vmap(self.reset))
@@ -85,36 +163,51 @@ class TriangleEnv:
         self.step_1 = jax.jit(self.step)
         self.valid_mask_1 = jax.jit(self.valid_action_mask)
 
+    # --- bitboard helpers -------------------------------------------------
+
+    def unpack_grid(self, words: jax.Array) -> jax.Array:
+        """(NW,) uint32 -> (R, C) bool occupancy grid (traceable)."""
+        bits = (words[self._cell_word] >> self._cell_bit) & jnp.uint32(1)
+        return (bits > 0).reshape(self.rows, self.cols)
+
+    def unpack_grid_np(self, words: np.ndarray) -> np.ndarray:
+        """Host-side twin of `unpack_grid`."""
+        t = self._tables_np
+        bits = (np.asarray(words)[t.cell_word] >> t.cell_bit) & np.uint32(1)
+        return (bits > 0).reshape(self.rows, self.cols)
+
+    def pack_grid_np(self, grid: np.ndarray) -> np.ndarray:
+        """(R, C) bool -> (NW,) uint32 (host-side; tests/adapters)."""
+        return _pack_np(grid, self.num_words)
+
+    def _or_words(self, words: jax.Array) -> jax.Array:
+        """Bitwise-OR reduce over the trailing word axis (static width)."""
+        acc = words[..., 0]
+        for w in range(1, words.shape[-1]):
+            acc = acc | words[..., w]
+        return acc
+
     # --- transition functions (single game; vmap for batches) -------------
 
-    def _slot_placements(self, occupied: jax.Array, shape_idx: jax.Array) -> jax.Array:
-        """(R, C) bool of valid origins for one slot's shape.
-
-        Returns all-False for an empty slot (shape_idx < 0).
-        """
+    def _legal_per_slot(
+        self, occupied: jax.Array, shape_idx: jax.Array
+    ) -> jax.Array:
+        """(SLOTS, R*C) bool legality of every origin for every slot."""
         sidx = jnp.maximum(shape_idx, 0)
-        tr = self._rr[:, :, None] + self._tri_r[sidx][None, None, :]  # (R, C, T)
-        tc = self._cc[:, :, None] + self._tri_c[sidx][None, None, :]
-        inb = (tr >= 0) & (tr < self.rows) & (tc >= 0) & (tc < self.cols)
-        trc = jnp.clip(tr, 0, self.rows - 1)
-        tcc = jnp.clip(tc, 0, self.cols - 1)
-        free = ~(occupied[trc, tcc] | self._death[trc, tcc])
-        parity_ok = ((tr + tc) % 2 == 0) == self._tri_up[sidx][None, None, :]
-        ok = (inb & free & parity_ok) | ~self._tri_valid[sidx][None, None, :]
-        return ok.all(axis=-1) & (shape_idx >= 0)
+        fp = self._fp_ext[sidx]  # (SLOTS, R*C, NW+1)
+        occ_ext = jnp.concatenate([occupied, self._ones_word])  # (NW+1,)
+        collide = self._or_words(fp & occ_ext[None, None, :])
+        return (collide == 0) & (shape_idx >= 0)[:, None]
 
     def valid_action_mask(self, state: EnvState) -> jax.Array:
         """(action_dim,) bool; all-False when the game is over."""
-        per_slot = jax.vmap(self._slot_placements, in_axes=(None, 0))(
-            state.occupied, state.shape_idx
-        )  # (SLOTS, R, C)
-        return per_slot.reshape(-1) & ~state.done
+        legal = self._legal_per_slot(state.occupied, state.shape_idx)
+        return legal.reshape(-1) & ~state.done
 
-    def _any_placement(self, occupied: jax.Array, shape_idx: jax.Array) -> jax.Array:
-        per_slot = jax.vmap(self._slot_placements, in_axes=(None, 0))(
-            occupied, shape_idx
-        )
-        return per_slot.any()
+    def _any_placement(
+        self, occupied: jax.Array, shape_idx: jax.Array
+    ) -> jax.Array:
+        return self._legal_per_slot(occupied, shape_idx).any()
 
     def _draw_hand(self, key: jax.Array) -> tuple[jax.Array, jax.Array]:
         k1, k2 = jax.random.split(key)
@@ -126,7 +219,7 @@ class TriangleEnv:
         key, sub = jax.random.split(key)
         shape_idx, shape_color = self._draw_hand(sub)
         state = EnvState(
-            occupied=jnp.zeros((self.rows, self.cols), dtype=bool),
+            occupied=jnp.zeros((self.num_words,), dtype=jnp.uint32),
             color=jnp.full((self.rows, self.cols), -1, dtype=jnp.int8),
             shape_idx=shape_idx,
             shape_color=shape_color,
@@ -143,33 +236,47 @@ class TriangleEnv:
     def step(self, state: EnvState, action: jax.Array) -> tuple[EnvState, jax.Array, jax.Array]:
         """Apply one action. Returns (next_state, reward, done)."""
         cfg = self.cfg
-        cells = self.rows * self.cols
+        cells = self.cells
         slot = action // cells
-        r = (action % cells) // self.cols
-        c = action % self.cols
+        origin = action % cells
+        r = origin // self.cols
+        c = origin % self.cols
 
         sidx = jnp.maximum(state.shape_idx[slot], 0)
-        placeable = self._slot_placements(state.occupied, state.shape_idx[slot])
-        valid = placeable[r, c] & ~state.done
+        fp_ext = self._fp_ext[sidx, origin]  # (NW+1,)
+        occ_ext = jnp.concatenate([state.occupied, self._ones_word])
+        collide = self._or_words(fp_ext & occ_ext)
+        valid = (collide == 0) & (state.shape_idx[slot] >= 0) & ~state.done
 
         # --- place ---
-        # Padding triangles get an out-of-bounds row so drop-mode scatters
-        # ignore them (clipping could alias a real cell and corrupt it).
+        fp = fp_ext[: self.num_words]
+        occ_placed = state.occupied | fp
+        n_placed = self._n_tris[sidx]
+        # Color plane (cold parity data): scatter the shape's cells.
+        # Padding triangles get an out-of-bounds row so drop-mode
+        # scatters ignore them.
         tri_on = self._tri_valid[sidx]
         tr = jnp.where(tri_on, r + self._tri_r[sidx], self.rows)
         tc = c + self._tri_c[sidx]
-        occ_placed = state.occupied.at[tr, tc].set(True, mode="drop")
         color_placed = state.color.at[tr, tc].set(
             state.shape_color[slot], mode="drop"
         )
-        n_placed = self._n_tris[sidx]
 
         # --- clear full lines ---
-        full = (occ_placed | ~self._line_masks).all(axis=(1, 2))  # (L,)
-        cleared_cells = (self._line_masks & full[:, None, None]).any(axis=0)
-        n_cleared = cleared_cells.sum(dtype=jnp.int32)
-        occ_next = occ_placed & ~cleared_cells
-        color_next = jnp.where(cleared_cells, jnp.int8(-1), color_placed)
+        miss = (occ_placed[None, :] & self._line_words) ^ self._line_words
+        full = self._or_words(miss) == 0 if self._line_words.shape[0] else jnp.zeros((0,), bool)
+        masked = jnp.where(
+            full[:, None], self._line_words, jnp.uint32(0)
+        )
+        cleared = (
+            self._or_words(jnp.swapaxes(masked, 0, 1))
+            if masked.shape[0]
+            else jnp.zeros((self.num_words,), jnp.uint32)
+        )
+        n_cleared = jax.lax.population_count(cleared).sum().astype(jnp.int32)
+        occ_next = occ_placed & ~cleared
+        cleared_grid = self.unpack_grid(cleared)
+        color_next = jnp.where(cleared_grid, jnp.int8(-1), color_placed)
 
         # --- consume slot; refill when the hand is empty ---
         hand = state.shape_idx.at[slot].set(-1)

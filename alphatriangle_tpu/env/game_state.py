@@ -97,7 +97,9 @@ class GameState:
     def get_grid_data_np(self) -> dict[str, np.ndarray]:
         """Dense grid views: occupied / death / color_id (copies)."""
         return {
-            "occupied": np.asarray(self._state.occupied),
+            "occupied": self._env.unpack_grid_np(
+                np.asarray(self._state.occupied)
+            ),
             "death": self._env.geometry.death.copy(),
             "color_id": np.asarray(self._state.color),
         }

@@ -102,8 +102,9 @@ class FeatureExtractor:
         cfg = self.env.cfg
         death = self._death
 
+        occupied = self.env.unpack_grid(state.occupied)  # (R, C) bool
         grid0 = jnp.where(
-            death, jnp.float32(-1.0), state.occupied.astype(jnp.float32)
+            death, jnp.float32(-1.0), occupied.astype(jnp.float32)
         )
         grid = jnp.zeros(
             (self.model_config.GRID_INPUT_CHANNELS, cfg.ROWS, cfg.COLS),
@@ -118,8 +119,8 @@ class FeatureExtractor:
         shape_feats = self._shape_table[slot_rows].reshape(-1)  # (SLOTS*7,)
         availability = (state.shape_idx >= 0).astype(jnp.float32)  # (SLOTS,)
 
-        heights = grid_features.column_heights(state.occupied, death)
-        holes = grid_features.count_holes(state.occupied, death, heights)
+        heights = grid_features.column_heights(occupied, death)
+        holes = grid_features.count_holes(occupied, death, heights)
         bump = grid_features.bumpiness(heights)
         rows_f = jnp.float32(cfg.ROWS)
         explicit = jnp.stack(

@@ -1,20 +1,40 @@
-"""Batched PUCT search over fixed-shape tree arrays.
+"""Batched wave-parallel PUCT search as dense MXU linear algebra.
 
 Functional equivalent of the observed trimcts surface
 (`alphatriangle/config/mcts_config.py:67-77`,
 `alphatriangle/rl/self_play/worker.py:273-280`): PUCT selection with
 cpuct, Dirichlet root noise, max-depth cutoff, discounted value backup,
-dense visit-count extraction.
+dense visit-count extraction, and batched leaf collection (the
+reference's `mcts_batch_size` C++ leaf batching,
+`mcts_config.py:57-62`).
 
 TPU-first design, not a translation of the C++ pointer tree:
-- A search over B games is ONE jitted computation. Tree state is a
-  struct-of-arrays pytree with leading dims (B, N) where
-  N = max_simulations + 1 node slots (root + one expansion per sim).
-- Each simulation does: vmapped PUCT descent (bounded `lax.while_loop`)
-  -> one batched env.step for all B selected edges -> one batched
-  feature-extract + network apply for all B new leaves (the MXU call)
-  -> vmapped discounted backup along parent chains.
-- All shapes static; no Python control flow inside jit.
+- A search over B games is ONE jitted computation. Tree statistics are
+  **edge-indexed** struct-of-arrays with dims (B, N, A): visit counts,
+  return sums, rewards, priors, validity, and child ids all live on
+  edges (node x action), so everything PUCT needs at a node is one
+  contiguous row — never a per-action pointer chase.
+- Simulations run in **waves of W members** (W = `mcts_batch_size`
+  clamped to a divisor of max_simulations). Each wave:
+    1. W parallel PUCT descents per tree, a static `fori_loop` over
+       max_depth levels. Each level reads its tree rows with ONE
+       batched one-hot matmul `(B,W,N) x (B,N,6A)` against a per-wave
+       concatenation of the six stat planes — an MXU contraction, not
+       a gather. Descents are diversified by per-member Gumbel
+       perturbation (`wave_noise_scale`) instead of sequential
+       virtual loss, and record their (node, action, reward) path.
+    2. one batched env.step over the B*W selected edges (bitboards);
+    3. ONE fused network evaluation of all B*W leaves;
+    4. block insertion of the W new node slots via dynamic-slice
+       updates; within-wave duplicate edges are canonicalized to a
+       single child (duplicates and re-expanded edges become orphan
+       slots, counted in `wasted_slots`);
+    5. discounted backup along the recorded paths: max_depth static
+       rounds of (B, W)-sized scatter-adds into the edge planes — no
+       data-dependent `while` walk, no parent pointers.
+- All shapes static; no Python control flow inside jit. Sequential
+  dispatch rounds per search scale with (sims/W) * max_depth, and the
+  per-round work is dense f32 vector/matrix math.
 - Terminal nodes evaluate to value 0 and step as no-ops (the engine
   freezes finished games), so finished games in a batch stay in
   lockstep at zero extra cost.
@@ -22,6 +42,7 @@ TPU-first design, not a translation of the C++ pointer tree:
   absent: with B games searched per dispatch, re-searching from the
   root each move keeps shapes static and the MXU saturated; the
   root-prior already encodes the network's (fresher) knowledge.
+  `wasted_slots` quantifies the orphan overhead this design accepts.
 """
 
 from typing import Any
@@ -37,18 +58,17 @@ from ..features.core import FeatureExtractor
 
 @struct.dataclass
 class Tree:
-    """Search-tree arrays for one game (batched: add a leading B dim)."""
+    """Edge-indexed search arrays, batched over B games."""
 
-    node_state: EnvState  # (N, ...) game state at each node
-    visits: jax.Array  # (N,) int32
-    value_sum: jax.Array  # (N,) float32 sum of backed-up returns
-    prior: jax.Array  # (N, A) float32 masked policy priors
-    valid: jax.Array  # (N, A) bool valid-action masks
-    children: jax.Array  # (N, A) int32 child node index; -1 = unexpanded
-    parent: jax.Array  # (N,) int32; -1 at root
-    parent_action: jax.Array  # (N,) int32; -1 at root
-    reward: jax.Array  # (N,) float32 reward on the edge into this node
-    terminal: jax.Array  # (N,) bool
+    node_state: EnvState  # (B, N, ...) game state at each node slot
+    e_visits: jax.Array  # (B, N, A) f32 edge visit counts
+    e_value: jax.Array  # (B, N, A) f32 sum of discounted returns G(edge)
+    e_reward: jax.Array  # (B, N, A) f32 reward on the edge (set at expand)
+    children: jax.Array  # (B, N, A) f32 child slot id; -1 = unexpanded
+    prior: jax.Array  # (B, N, A) f32 masked policy priors
+    valid: jax.Array  # (B, N, A) f32 1.0 where the action is valid
+    terminal: jax.Array  # (B, N) bool
+    root_value0: jax.Array  # (B,) f32 network value of the root at init
 
 
 @struct.dataclass
@@ -59,6 +79,7 @@ class SearchOutput:
     root_value: jax.Array  # (B,) float32 mean backed-up root value
     root_prior: jax.Array  # (B, A) float32 noisy root prior (debug)
     total_simulations: jax.Array  # () int32
+    wasted_slots: jax.Array  # (B,) int32 orphan node slots (see module doc)
 
 
 class BatchedMCTS:
@@ -85,6 +106,13 @@ class BatchedMCTS:
         self.support = value_support
         self.num_nodes = config.max_simulations + 1
         self.action_dim = env.action_dim
+        # Wave size: largest divisor of max_simulations <= mcts_batch_size,
+        # so waves tile the simulation budget exactly.
+        w = max(1, min(config.mcts_batch_size, config.max_simulations))
+        while config.max_simulations % w:
+            w -= 1
+        self.wave_size = w
+        self.num_waves = config.max_simulations // w
         self.search = jax.jit(self._search)
 
     # --- network evaluation ----------------------------------------------
@@ -115,87 +143,6 @@ class BatchedMCTS:
         value_probs = jax.nn.softmax(value_logits, axis=-1)
         values = jnp.sum(value_probs * self.support, axis=-1)
         return priors, values, valid
-
-    # --- per-tree primitives (single game; vmapped) -----------------------
-
-    def _puct_scores(self, tree: Tree, node: jax.Array) -> jax.Array:
-        """(A,) PUCT score of each action at `node`."""
-        cfg = self.config
-        child = tree.children[node]  # (A,)
-        cidx = jnp.maximum(child, 0)
-        expanded = child >= 0
-        c_visits = jnp.where(expanded, tree.visits[cidx], 0)
-        c_value = jnp.where(
-            c_visits > 0, tree.value_sum[cidx] / jnp.maximum(c_visits, 1), 0.0
-        )
-        q = jnp.where(
-            expanded, tree.reward[cidx] + cfg.discount * c_value, 0.0
-        )
-        u = (
-            cfg.cpuct
-            * tree.prior[node]
-            * jnp.sqrt(tree.visits[node].astype(jnp.float32))
-            / (1.0 + c_visits.astype(jnp.float32))
-        )
-        return jnp.where(tree.valid[node], q + u, -jnp.inf)
-
-    def _select_leaf(self, tree: Tree) -> tuple[jax.Array, jax.Array]:
-        """Descend by PUCT until an unexpanded edge / depth cap / terminal.
-
-        Returns (parent node index, action to expand).
-        """
-        max_depth = self.config.max_depth
-
-        def cond(carry):
-            _, _, _, stop = carry
-            return ~stop
-
-        def body(carry):
-            node, _, depth, _ = carry
-            action = jnp.argmax(self._puct_scores(tree, node))
-            child = tree.children[node, action]
-            stop = (
-                (child < 0)
-                | (depth + 1 >= max_depth)
-                | tree.terminal[node]
-            )
-            next_node = jnp.where(stop, node, child)
-            return next_node, action, depth + 1, stop
-
-        node, action, _, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
-        )
-        return node, action
-
-    def _backup(
-        self, tree: Tree, leaf: jax.Array, leaf_value: jax.Array
-    ) -> tuple[jax.Array, jax.Array]:
-        """Discounted backup from `leaf` to root; returns updated
-        (visits, value_sum)."""
-        discount = self.config.discount
-
-        def cond(carry):
-            node, *_ = carry
-            return node >= 0
-
-        def body(carry):
-            # Under vmap, lanes that already reached the root keep
-            # executing this body while other lanes walk; guard every
-            # update so a finished lane (node == -1) is a strict no-op
-            # instead of wrap-indexing the last slot.
-            node, g, visits, value_sum = carry
-            active = node >= 0
-            safe = jnp.maximum(node, 0)
-            visits = visits.at[safe].add(jnp.where(active, 1, 0))
-            value_sum = value_sum.at[safe].add(jnp.where(active, g, 0.0))
-            g = jnp.where(active, tree.reward[safe] + discount * g, g)
-            node = jnp.where(active, tree.parent[safe], node)
-            return node, g, visits, value_sum
-
-        _, _, visits, value_sum = jax.lax.while_loop(
-            cond, body, (leaf, leaf_value, tree.visits, tree.value_sum)
-        )
-        return visits, value_sum
 
     # --- the search -------------------------------------------------------
 
@@ -229,21 +176,245 @@ class BatchedMCTS:
 
         node_state = jax.tree_util.tree_map(broadcast_to_nodes, root_states)
         zeros_na = jnp.zeros((batch, n, a), dtype=jnp.float32)
-        tree = Tree(
+        return Tree(
             node_state=node_state,
-            visits=jnp.zeros((batch, n), dtype=jnp.int32).at[:, 0].set(1),
-            value_sum=jnp.zeros((batch, n), dtype=jnp.float32)
-            .at[:, 0]
-            .set(root_value),
+            e_visits=zeros_na,
+            e_value=zeros_na,
+            e_reward=zeros_na,
+            children=jnp.full((batch, n, a), -1.0, dtype=jnp.float32),
             prior=zeros_na.at[:, 0].set(priors),
-            valid=jnp.zeros((batch, n, a), dtype=bool).at[:, 0].set(valid),
-            children=jnp.full((batch, n, a), -1, dtype=jnp.int32),
-            parent=jnp.full((batch, n), -1, dtype=jnp.int32),
-            parent_action=jnp.full((batch, n), -1, dtype=jnp.int32),
-            reward=jnp.zeros((batch, n), dtype=jnp.float32),
+            valid=zeros_na.at[:, 0].set(valid.astype(jnp.float32)),
             terminal=jnp.zeros((batch, n), dtype=bool).at[:, 0].set(root_terminal),
+            root_value0=root_value,
         )
-        return tree
+
+    def _descend_wave(self, tree: Tree, wave_rng: jax.Array, batch: int):
+        """W parallel recorded descents per tree.
+
+        Returns a dict of (B, W[, D]) arrays: final (parent, action,
+        existing child), and the recorded path (nodes, actions,
+        traversal rewards, active mask) for backup. Gumbel score noise
+        (`wave_noise_scale`) is sampled per level from `wave_rng` so
+        no (B, W, D, A) tensor is ever materialized.
+        """
+        cfg = self.config
+        w, a = self.wave_size, self.action_dim
+        depth = cfg.max_depth
+        n = self.num_nodes
+        iota_n = jnp.arange(n, dtype=jnp.int32)
+
+        # Per-wave dense stat block: one (B, N, 6A) tensor so each
+        # descent level is a single batched matmul row-read.
+        stats = jnp.concatenate(
+            [
+                tree.e_visits,
+                tree.e_value,
+                tree.e_reward,
+                tree.prior,
+                tree.valid,
+                tree.children,
+            ],
+            axis=-1,
+        )  # (B, N, 6A)
+
+        def level(d, carry):
+            node, action, stop, rec_node, rec_action, rec_reward, rec_active = carry
+            node_oh = (node[..., None] == iota_n).astype(jnp.float32)  # (B,W,N)
+            rows = jnp.einsum(
+                "bwn,bnk->bwk",
+                node_oh,
+                stats,
+                precision=jax.lax.Precision.HIGHEST,
+            )  # (B, W, 6A) — exact f32 row select on the MXU
+            visits_r = rows[..., 0 * a : 1 * a]
+            value_r = rows[..., 1 * a : 2 * a]
+            reward_r = rows[..., 2 * a : 3 * a]
+            prior_r = rows[..., 3 * a : 4 * a]
+            valid_r = rows[..., 4 * a : 5 * a]
+            child_r = rows[..., 5 * a : 6 * a]
+
+            n_node = 1.0 + visits_r.sum(axis=-1, keepdims=True)
+            q = jnp.where(
+                visits_r > 0, value_r / jnp.maximum(visits_r, 1e-9), 0.0
+            )
+            u = (
+                cfg.cpuct
+                * prior_r
+                * jnp.sqrt(n_node)
+                / (1.0 + visits_r)
+            )
+            # Noise only matters with >1 wave member; at W=1 keep exact
+            # PUCT so sequential configs reproduce reference selection.
+            if w > 1 and cfg.wave_noise_scale > 0:
+                noise = cfg.wave_noise_scale * jax.random.gumbel(
+                    jax.random.fold_in(wave_rng, d), (batch, w, a)
+                )
+            else:
+                noise = 0.0
+            scores = jnp.where(valid_r > 0, q + u, -jnp.inf) + noise
+            act = jnp.argmax(scores, axis=-1).astype(jnp.int32)  # (B, W)
+            act_oh = jax.nn.one_hot(act, a, dtype=jnp.float32)
+            child = (
+                (child_r * act_oh).sum(axis=-1).astype(jnp.int32)
+            )  # (B, W); -1 = unexpanded
+            r_edge = (reward_r * act_oh).sum(axis=-1)
+            term = jnp.take_along_axis(tree.terminal, node, axis=1)
+            stop_now = (child < 0) | (d + 1 >= depth) | term
+
+            active = ~stop
+            rec_node = rec_node.at[:, :, d].set(jnp.where(active, node, -1))
+            rec_action = rec_action.at[:, :, d].set(
+                jnp.where(active, act, -1)
+            )
+            rec_reward = rec_reward.at[:, :, d].set(
+                jnp.where(active, r_edge, 0.0)
+            )
+            rec_active = rec_active.at[:, :, d].set(active)
+
+            action = jnp.where(stop, action, act)
+            node = jnp.where(stop | stop_now, node, child)
+            return (
+                node,
+                action,
+                stop | stop_now,
+                rec_node,
+                rec_action,
+                rec_reward,
+                rec_active,
+            )
+
+        node0 = jnp.zeros((batch, w), jnp.int32)
+        carry = (
+            node0,
+            jnp.zeros((batch, w), jnp.int32),
+            jnp.zeros((batch, w), bool),
+            jnp.full((batch, w, depth), -1, jnp.int32),
+            jnp.full((batch, w, depth), -1, jnp.int32),
+            jnp.zeros((batch, w, depth), jnp.float32),
+            jnp.zeros((batch, w, depth), bool),
+        )
+        parents, actions, _, rec_node, rec_action, rec_reward, rec_active = (
+            jax.lax.fori_loop(0, depth, level, carry, unroll=True)
+        )
+        existing = (
+            jnp.take_along_axis(
+                tree.children.reshape(batch, -1),
+                (parents * a + actions),
+                axis=1,
+            )
+        ).astype(jnp.int32)  # (B, W)
+        return {
+            "parents": parents,
+            "actions": actions,
+            "existing": existing,
+            "rec_node": rec_node,
+            "rec_action": rec_action,
+            "rec_reward": rec_reward,
+            "rec_active": rec_active,
+        }
+
+    def _wave(self, variables, batch: int, carry, wave_rng):
+        """One wave: W parallel sims across all B trees."""
+        cfg = self.config
+        tree, wasted, base = carry
+        w, a = self.wave_size, self.action_dim
+        depth = cfg.max_depth
+        barange = jnp.arange(batch)
+        warange = jnp.arange(w)
+        bcol = barange[:, None]
+
+        # 1. W parallel recorded descents per tree.
+        d = self._descend_wave(tree, wave_rng, batch)
+        parents, actions, existing = d["parents"], d["actions"], d["existing"]
+        is_new = existing < 0
+
+        # Canonicalize within-wave duplicates: members that chose the
+        # same edge share one child node — the one belonging to the
+        # highest member index (matching the `.max()` scatter below).
+        key = parents * a + actions  # (B, W)
+        same = key[:, :, None] == key[:, None, :]  # (B, W, W)
+        later = warange[None, None, :] > warange[None, :, None]
+        is_canon = ~(same & later).any(axis=-1)  # (B, W)
+
+        # 2. Expansion: one batched env.step over all B*W edges.
+        # (The engine is deterministic given the node's PRNG state, so
+        # duplicate/revisited edges reproduce the same child state.)
+        parent_states = jax.tree_util.tree_map(
+            lambda x: x[bcol, parents].reshape((batch * w,) + x.shape[2:]),
+            tree.node_state,
+        )
+        new_states, rewards, dones = jax.vmap(self.env.step)(
+            parent_states, actions.reshape(-1)
+        )
+        rewards = rewards.reshape(batch, w)
+        dones = dones.reshape(batch, w)
+
+        # 3. Evaluation: ONE fused network call for all B*W leaves.
+        priors, values, valid = self._evaluate(variables, new_states)
+        leaf_values = jnp.where(dones, 0.0, values.reshape(batch, w))
+
+        # 4. Insert the wave's W node slots as one block at [base, base+W).
+        def insert(buf, block):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, block.astype(buf.dtype), base, axis=1
+            )
+
+        ns = jax.tree_util.tree_map(
+            lambda buf, x: insert(buf, x.reshape((batch, w) + x.shape[1:])),
+            tree.node_state,
+            new_states,
+        )
+        slot_ids = (base + warange[None, :]).astype(jnp.float32)  # (1, W)
+        live = is_new & is_canon
+        tree = tree.replace(
+            node_state=ns,
+            prior=insert(tree.prior, priors.reshape(batch, w, a)),
+            valid=insert(
+                tree.valid, valid.reshape(batch, w, a).astype(jnp.float32)
+            ),
+            terminal=insert(tree.terminal, dones),
+            children=tree.children.at[bcol, parents, actions].max(
+                jnp.where(is_new, slot_ids, -1.0)
+            ),
+            e_reward=tree.e_reward.at[bcol, parents, actions].set(rewards),
+        )
+
+        # 5. Backup along the recorded paths. Suffix returns first:
+        # G_d = r_d + discount * G_{d+1}, where the deepest active
+        # level's reward is the fresh step reward (a new edge has no
+        # stored reward yet; for revisits the stored value is identical
+        # by determinism).
+        rec_node, rec_action = d["rec_node"], d["rec_action"]
+        rec_active = d["rec_active"]  # (B, W, D)
+        last_idx = rec_active.sum(axis=-1) - 1  # (B, W) deepest level
+        g = leaf_values  # (B, W)
+        contrib = []
+        for lvl in range(depth - 1, -1, -1):
+            is_last = rec_active[:, :, lvl] & (last_idx == lvl)
+            r_lvl = jnp.where(
+                is_last, rewards, d["rec_reward"][:, :, lvl]
+            )
+            g = jnp.where(
+                rec_active[:, :, lvl], r_lvl + cfg.discount * g, g
+            )
+            contrib.append(g)
+        contrib.reverse()  # contrib[lvl] = G at level lvl, (B, W)
+
+        e_visits, e_value = tree.e_visits, tree.e_value
+        for lvl in range(depth):
+            act_mask = rec_active[:, :, lvl]
+            nd = jnp.maximum(rec_node[:, :, lvl], 0)
+            ac = jnp.maximum(rec_action[:, :, lvl], 0)
+            e_visits = e_visits.at[bcol, nd, ac].add(
+                act_mask.astype(jnp.float32)
+            )
+            e_value = e_value.at[bcol, nd, ac].add(
+                jnp.where(act_mask, contrib[lvl], 0.0)
+            )
+        tree = tree.replace(e_visits=e_visits, e_value=e_value)
+
+        wasted = wasted + (w - live.sum(axis=1, dtype=jnp.int32))
+        return tree, wasted, base + w
 
     def _search(
         self, variables, root_states: EnvState, rng: jax.Array
@@ -251,85 +422,35 @@ class BatchedMCTS:
         """Run `max_simulations` batched simulations from `root_states`."""
         cfg = self.config
         batch = root_states.done.shape[0]
-        rng, noise_rng = jax.random.split(rng)
+        rng, noise_rng, wave_rng = jax.random.split(rng, 3)
         tree = self._init_tree(variables, root_states, noise_rng)
-        barange = jnp.arange(batch)
 
-        def sim_body(sim: jax.Array, tree: Tree) -> Tree:
-            # 1. Selection: vmapped descent over all B trees. The
-            # returned edge may already be expanded when the descent was
-            # stopped by the depth cap or a terminal node.
-            parents, actions = jax.vmap(self._select_leaf)(tree)
-            existing = tree.children[barange, parents, actions]  # (B,)
-            is_new = existing < 0
-
-            # 2. Expansion: one batched env.step over the selected edges.
-            # (The engine is deterministic given the node's PRNG state,
-            # so a revisited edge reproduces the existing child's state.)
-            parent_states = jax.tree_util.tree_map(
-                lambda x: x[barange, parents], tree.node_state
-            )
-            new_states, rewards, dones = jax.vmap(self.env.step)(
-                parent_states, actions
+        def wave_body(k, carry):
+            tree, wasted, base = carry
+            return self._wave(
+                variables,
+                batch,
+                (tree, wasted, base),
+                jax.random.fold_in(wave_rng, k),
             )
 
-            # 3. Evaluation: ONE batched network call for all B leaves.
-            priors, values, valid = self._evaluate(variables, new_states)
-            leaf_values = jnp.where(dones, 0.0, values)
-
-            # 4. Insert node `sim`. For revisited edges the existing
-            # child keeps the edge (and its accumulated statistics);
-            # slot `sim` is then an orphan with zero visits — a bounded
-            # waste that keeps every shape static.
-            node = sim  # scalar; same slot in every tree
-            target = jnp.where(is_new, node, existing)  # (B,) backup roots
-            ns = jax.tree_util.tree_map(
-                lambda buf, x: buf.at[:, node].set(x),
-                tree.node_state,
-                new_states,
-            )
-            tree = tree.replace(
-                node_state=ns,
-                prior=tree.prior.at[:, node].set(priors),
-                valid=tree.valid.at[:, node].set(valid),
-                children=tree.children.at[barange, parents, actions].set(
-                    target
-                ),
-                parent=tree.parent.at[:, node].set(
-                    jnp.where(is_new, parents, -1)
-                ),
-                parent_action=tree.parent_action.at[:, node].set(
-                    jnp.where(is_new, actions, -1)
-                ),
-                reward=tree.reward.at[:, node].set(rewards),
-                terminal=tree.terminal.at[:, node].set(dones),
-            )
-
-            # 5. Backup: vmapped discounted walk to the root, starting
-            # from the (possibly pre-existing) child of the chosen edge.
-            visits, value_sum = jax.vmap(self._backup)(
-                tree, target, leaf_values
-            )
-            return tree.replace(visits=visits, value_sum=value_sum)
-
-        tree = jax.lax.fori_loop(1, cfg.max_simulations + 1, sim_body, tree)
-
-        # Root visit counts: scatter child visits by parent_action for
-        # nodes whose parent is the root.
-        def root_counts(tree_i: Tree) -> jax.Array:
-            is_root_child = tree_i.parent == 0
-            counts = jnp.zeros(self.action_dim, dtype=jnp.float32)
-            return counts.at[
-                jnp.maximum(tree_i.parent_action, 0)
-            ].add(jnp.where(is_root_child, tree_i.visits, 0).astype(jnp.float32))
-
-        visit_counts = jax.vmap(root_counts)(tree)
-        root_value = tree.value_sum[:, 0] / jnp.maximum(
-            tree.visits[:, 0].astype(jnp.float32), 1.0
+        tree, wasted, _ = jax.lax.fori_loop(
+            0,
+            self.num_waves,
+            wave_body,
+            (tree, jnp.zeros((batch,), jnp.int32), jnp.int32(1)),
         )
+
+        # Root stats are just row 0 of the edge planes.
+        visit_counts = tree.e_visits[:, 0, :]
+        root_visits = 1.0 + visit_counts.sum(axis=-1)
+        root_value = (
+            tree.root_value0 + tree.e_value[:, 0, :].sum(axis=-1)
+        ) / root_visits
         return SearchOutput(
             visit_counts=visit_counts,
             root_value=root_value,
             root_prior=tree.prior[:, 0],
             total_simulations=jnp.int32(cfg.max_simulations * batch),
+            wasted_slots=wasted,
         )

@@ -262,6 +262,9 @@ class SelfPlayEngine:
                 "root_value": out.root_value,
                 "reward": rewards,
                 "ending": ending,
+                # Orphan node slots this search (duplicate/revisited
+                # edges) — the waste the no-tree-reuse design accepts.
+                "wasted_slots": out.wasted_slots,
             },
         }
         return new_carry, outputs

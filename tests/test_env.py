@@ -139,7 +139,7 @@ def test_place_and_score(tiny_env):
     state, reward, done = tiny_env.step(state, jnp.int32(0))
     assert float(reward) == tiny_env.cfg.REWARD_PER_PLACED_TRIANGLE
     assert float(state.score) == float(reward)
-    occ = np.asarray(state.occupied)
+    occ = tiny_env.unpack_grid_np(np.asarray(state.occupied))
     assert occ[0, 0] and occ.sum() == 1
     assert int(state.step_count) == 1 and not bool(done)
     assert int(state.last_cleared) == 0
@@ -159,7 +159,9 @@ def test_fill_row_clears_line(tiny_env):
     assert int(state.last_cleared) == 4
     # Last reward: 1 placed + 4 cleared * 2.0.
     assert float(reward) == pytest.approx(1.0 + 4 * 2.0)
-    assert not np.asarray(state.occupied)[0].any()  # row cleared
+    assert not tiny_env.unpack_grid_np(np.asarray(state.occupied))[
+        0
+    ].any()  # row cleared
     assert float(state.score) == pytest.approx(total)
 
 
@@ -169,7 +171,7 @@ def test_full_board_clears_everything(tiny_env):
     state = tiny_env.reset(jax.random.PRNGKey(0))
     occ = np.ones((3, 4), dtype=bool)
     occ[0, 0] = False
-    state = state.replace(occupied=jnp.asarray(occ))
+    state = state.replace(occupied=jnp.asarray(tiny_env.pack_grid_np(occ)))
     state = _hand(tiny_env, state, [0])
     state, reward, done = tiny_env.step(state, jnp.int32(0))
     assert int(state.last_cleared) == 12
@@ -208,7 +210,7 @@ def test_stuck_game_over_with_penalty():
     occ = np.ones((2, 2), dtype=bool)
     occ[0, 0] = False
     state = state.replace(
-        occupied=jnp.asarray(occ),
+        occupied=jnp.asarray(env.pack_grid_np(occ)),
         shape_idx=jnp.asarray([0], dtype=jnp.int32),
     )
     state, reward, done = env.step(state, jnp.int32(0))

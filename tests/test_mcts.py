@@ -174,3 +174,74 @@ class TestSearch:
         random_score = np.mean([play(random_policy, s) for s in (11, 22)])
         mcts_score = np.mean([play(mcts_policy, s) for s in (11, 22)])
         assert mcts_score > random_score
+
+
+class TestWaves:
+    """Wave-parallel mechanics: size clamp, duplicate canonicalization,
+    wasted-slot accounting, and exact PUCT at wave_size=1."""
+
+    def test_wave_size_clamped_to_divisor(
+        self, mcts_world, tiny_mcts_config
+    ):
+        env, fe, net, _ = mcts_world
+        cfg = tiny_mcts_config.model_copy(
+            update={"max_simulations": 10, "mcts_batch_size": 4}
+        )
+        mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+        assert mcts.wave_size == 2 and mcts.num_waves == 5
+        cfg = tiny_mcts_config.model_copy(
+            update={"max_simulations": 8, "mcts_batch_size": 7}
+        )
+        mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+        assert mcts.wave_size == 4 and mcts.num_waves == 2
+
+    def test_wave_duplicates_share_one_child_slot(self, mcts_world):
+        """After one wave: distinct edges own distinct child slots, and
+        the number of live (non-orphan) slots matches wasted_slots."""
+        env, fe, net, mcts = mcts_world
+        B = 4
+        roots = env.reset_batch(jax.random.split(jax.random.PRNGKey(5), B))
+        rng = jax.random.PRNGKey(6)
+        tree = mcts._init_tree(net.variables, roots, rng)
+        tree, wasted, base = mcts._wave(
+            net.variables,
+            B,
+            (tree, jnp.zeros((B,), jnp.int32), jnp.int32(1)),
+            jax.random.fold_in(rng, 0),
+        )
+        assert int(base) == 1 + mcts.wave_size
+        children = np.asarray(tree.children)
+        wasted = np.asarray(wasted)
+        for b in range(B):
+            kids = children[b][children[b] >= 0]
+            # No slot is shared across different edges.
+            assert len(kids) == len(set(kids.tolist()))
+            # Live slots + orphans tile the wave exactly.
+            assert len(kids) == mcts.wave_size - int(wasted[b])
+            assert 0 <= wasted[b] < mcts.wave_size
+
+    def test_wasted_slots_bounded_full_search(
+        self, mcts_world, tiny_mcts_config
+    ):
+        env, _, net, mcts = mcts_world
+        roots = env.reset_batch(jax.random.split(jax.random.PRNGKey(9), 8))
+        out = mcts.search(net.variables, roots, jax.random.PRNGKey(10))
+        wasted = np.asarray(out.wasted_slots)
+        assert np.all(wasted >= 0)
+        assert np.all(wasted <= tiny_mcts_config.max_simulations)
+
+    def test_wave_size_one_is_noise_free(self, mcts_world, tiny_mcts_config):
+        """W=1 must reproduce exact sequential PUCT: identical visit
+        counts for different wave RNG streams (no Gumbel perturbation)."""
+        env, fe, net, _ = mcts_world
+        cfg = tiny_mcts_config.model_copy(
+            update={"mcts_batch_size": 1, "dirichlet_epsilon": 0.0}
+        )
+        mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+        assert mcts.wave_size == 1
+        roots = env.reset_batch(jax.random.split(jax.random.PRNGKey(3), 4))
+        o1 = mcts.search(net.variables, roots, jax.random.PRNGKey(1))
+        o2 = mcts.search(net.variables, roots, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(
+            np.asarray(o1.visit_counts), np.asarray(o2.visit_counts)
+        )
