@@ -24,6 +24,11 @@ class PersistenceConfig(BaseModel):
     SAVE_BUFFER: bool = Field(default=True)
     BUFFER_SAVE_FREQ_STEPS: int = Field(default=10_000, ge=1)
     MLFLOW_TRACKING_URI: str | None = Field(default=None)
+    # Retention: keep only the newest K checkpoints / buffer spills
+    # (0 = unlimited). A 100k-step run at the reference cadence would
+    # otherwise accumulate 40 checkpoints + full-capacity spills.
+    KEEP_LAST_CHECKPOINTS: int = Field(default=5, ge=0)
+    KEEP_LAST_BUFFERS: int = Field(default=2, ge=0)
 
     def get_app_root_dir(self) -> Path:
         return Path(self.ROOT_DATA_DIR) / self.APP_NAME

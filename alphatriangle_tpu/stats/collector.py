@@ -14,9 +14,10 @@ from this environment; the writer degrades to TensorBoard-only
 (reference logs to both, `README.md:63-79`).
 """
 
+import json
 import logging
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from pathlib import Path
 
 import numpy as np
@@ -40,10 +41,17 @@ class StatsCollector:
         persistence: PersistenceConfig | None = None,
         use_tensorboard: bool = True,
         log_dir: str | Path | None = None,
+        history_limit: int = 1024,
     ):
         self._lock = threading.Lock()
         self._pending: dict[str, list[tuple[int, float]]] = defaultdict(list)
-        self._history: dict[str, list[tuple[int, float]]] = defaultdict(list)
+        # In-memory aggregate history is a convenience for tests and the
+        # console; TensorBoard owns the full series. Bound it so a 100k
+        # step run doesn't grow without limit (0 = unbounded).
+        maxlen = history_limit if history_limit > 0 else None
+        self._history: dict[str, deque[tuple[int, float]]] = defaultdict(
+            lambda: deque(maxlen=maxlen)
+        )
         self._writer = None
         if use_tensorboard and SummaryWriter is not None:
             tb_dir = Path(log_dir) if log_dir else (
@@ -95,6 +103,25 @@ class StatsCollector:
     def force_process_and_log(self, global_step: int) -> dict[str, float]:
         """Final flush (reference `runner.py:288` semantics)."""
         return self.process_and_log(global_step)
+
+    # --- experiment params --------------------------------------------------
+
+    def log_params(self, configs: dict[str, object]) -> None:
+        """Record experiment parameters in TensorBoard (text summaries).
+
+        Equivalent of the reference's MLflow param dump
+        (`training/logging_utils.py:13-35`); MLflow is absent here so
+        params land as one markdown text card per config model.
+        """
+        if self._writer is None:
+            return
+        for name, cfg in configs.items():
+            payload = cfg.model_dump() if hasattr(cfg, "model_dump") else cfg
+            text = "```json\n" + json.dumps(
+                payload, indent=2, default=str
+            ) + "\n```"
+            self._writer.add_text(f"config/{name}", text, 0)
+        self._writer.flush()
 
     # --- introspection ----------------------------------------------------
 

@@ -77,7 +77,47 @@ class CheckpointManager:
             json.dumps(meta, indent=2)
         )
         logger.info("Checkpoint saved at step %d -> %s", step, path)
+        self._prune_checkpoints(just_saved=step)
         return path
+
+    def _prune_checkpoints(self, just_saved: int) -> None:
+        keep = self.config.KEEP_LAST_CHECKPOINTS
+        if keep <= 0:
+            return
+        # The save above is async; its directory may not be listable
+        # yet, so count the just-saved step explicitly.
+        steps = sorted(
+            {
+                int(m.group(1))
+                for p in self._ckpt_dir.iterdir()
+                if p.is_dir() and (m := _STEP_DIR_RE.match(p.name))
+            }
+            | {just_saved}
+        )
+        if len(steps) <= keep:
+            return
+        import shutil
+
+        # Async writes to the survivors may be in flight; only the
+        # doomed dirs matter, but Orbax tracks saves globally.
+        self._ckptr.wait_until_finished()
+        for step in steps[:-keep]:
+            shutil.rmtree(
+                self._ckpt_dir / f"step_{step:08d}", ignore_errors=True
+            )
+            (self._ckpt_dir / f"step_{step:08d}.meta.json").unlink(
+                missing_ok=True
+            )
+            logger.debug("Pruned checkpoint step %d", step)
+
+    def _prune_buffers(self) -> None:
+        keep = self.config.KEEP_LAST_BUFFERS
+        if keep <= 0:
+            return
+        spills = sorted(self._buffer_dir.glob("buffer_*.npz"))
+        for path in spills[:-keep] if len(spills) > keep else []:
+            path.unlink(missing_ok=True)
+            logger.debug("Pruned buffer spill %s", path.name)
 
     def save_buffer(self, step: int, buffer: ExperienceBuffer) -> Path | None:
         state = buffer.get_state()
@@ -91,6 +131,7 @@ class CheckpointManager:
             path, pos=state["pos"], size=state["size"], **arrays
         )
         logger.info("Buffer spilled (%d experiences) -> %s", state["size"], path)
+        self._prune_buffers()
         return path
 
     def save_configs(self, configs: dict[str, Any]) -> None:

@@ -82,16 +82,17 @@ def setup_training_components(
     )
     stats = StatsCollector(persistence_config, use_tensorboard=use_tensorboard)
     checkpoints = CheckpointManager(persistence_config)
-    checkpoints.save_configs(
-        {
-            "env": env_config,
-            "model": model_config,
-            "train": train_config,
-            "mcts": mcts_config,
-            "mesh": mesh_config,
-            "persistence": persistence_config,
-        }
-    )
+    all_configs = {
+        "env": env_config,
+        "model": model_config,
+        "train": train_config,
+        "mcts": mcts_config,
+        "mesh": mesh_config,
+        "persistence": persistence_config,
+    }
+    checkpoints.save_configs(all_configs)
+    # Experiment-param channel (reference `logging_utils.py:13-35`).
+    stats.log_params(all_configs)
     logger.info(
         "Components ready: mesh %s, self-play batch %d, run %s",
         dict(mesh.shape),

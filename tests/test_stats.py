@@ -43,6 +43,23 @@ class TestCollector:
         col.close()
         assert list((tmp_path / "tb").glob("events.out.tfevents.*"))
 
+    def test_history_bounded(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb", history_limit=4)
+        for step in range(10):
+            col.log_scalar("m", float(step), step)
+            col.process_and_log(step)
+        series = col.get_series("m")
+        assert len(series) == 4
+        assert series == [(6, 6.0), (7, 7.0), (8, 8.0), (9, 9.0)]
+        col.close()
+
+    def test_log_params_writes_text(self, tmp_path, tiny_env_config):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.log_params({"env": tiny_env_config, "plain": {"k": 1}})
+        col.close()
+        files = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+        assert files and files[0].stat().st_size > 0
+
 
 def per_cfg(tmp_path, run="run_a") -> PersistenceConfig:
     return PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME=run)
@@ -78,6 +95,60 @@ class TestCheckpointManager:
         ):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert int(trainer2.state.step) == 1
+
+    def test_checkpoint_retention_prunes_oldest(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        cfg = per_cfg(tmp_path).model_copy(
+            update={"KEEP_LAST_CHECKPOINTS": 2, "KEEP_LAST_BUFFERS": 1}
+        )
+        mgr = CheckpointManager(cfg)
+        for step in (1, 2, 3, 4):
+            mgr.save(step, trainer.state)
+        mgr.wait_until_finished()
+        kept = sorted(
+            p.name
+            for p in cfg.get_checkpoint_dir().iterdir()
+            if p.is_dir()
+        )
+        assert kept == ["step_00000003", "step_00000004"]
+        # Meta files pruned alongside their checkpoint dirs.
+        metas = sorted(
+            p.name for p in cfg.get_checkpoint_dir().glob("*.meta.json")
+        )
+        assert metas == ["step_00000003.meta.json", "step_00000004.meta.json"]
+        # Restore still lands on the newest survivor.
+        assert mgr.latest_step() == 4
+
+        tc = TrainConfig(
+            BATCH_SIZE=4, BUFFER_CAPACITY=64, MIN_BUFFER_SIZE_TO_TRAIN=8,
+            MAX_TRAINING_STEPS=10, RUN_NAME="t",
+        )
+        from tests.test_buffer import make_dense
+
+        buf = ExperienceBuffer(tc)
+        buf.add_dense(*make_dense(4))
+        for step in (1, 2, 3):
+            mgr.save_buffer(step, buf)
+        spills = sorted(p.name for p in cfg.get_buffer_dir().iterdir())
+        assert spills == ["buffer_00000003.npz"]
+
+    def test_retention_zero_keeps_everything(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        cfg = per_cfg(tmp_path).model_copy(
+            update={"KEEP_LAST_CHECKPOINTS": 0}
+        )
+        mgr = CheckpointManager(cfg)
+        for step in (1, 2, 3):
+            mgr.save(step, trainer.state)
+        mgr.wait_until_finished()
+        dirs = [p for p in cfg.get_checkpoint_dir().iterdir() if p.is_dir()]
+        assert len(dirs) == 3
 
     def test_restore_empty_run(self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config):
         net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
