@@ -59,10 +59,21 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         help="Compute platform; cpu forces the CPU backend even when an "
         "accelerator plugin is present.",
     )
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        help="Join a jax.distributed cluster (auto-discovery on TPU "
+        "pods; use --coordinator/--num-processes/--process-id for "
+        "explicit clusters).",
+    )
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
 
 
 def cmd_train(args: argparse.Namespace) -> int:
     from .config import PersistenceConfig, TrainConfig
+    from .parallel.distributed import DistributedConfig
     from .training.runner import run_training
 
     overrides: dict = {}
@@ -101,9 +112,18 @@ def cmd_train(args: argparse.Namespace) -> int:
         persistence_config = PersistenceConfig(
             ROOT_DATA_DIR=args.root_dir, RUN_NAME=train_config.RUN_NAME
         )
+    distributed_config = None
+    if args.distributed or args.coordinator is not None:
+        distributed_config = DistributedConfig(
+            ENABLED=True,
+            COORDINATOR_ADDRESS=args.coordinator,
+            NUM_PROCESSES=args.num_processes,
+            PROCESS_ID=args.process_id,
+        )
     return run_training(
         train_config=train_config,
         persistence_config=persistence_config,
+        distributed_config=distributed_config,
         log_level=args.log_level,
         use_tensorboard=not args.no_tensorboard,
     )

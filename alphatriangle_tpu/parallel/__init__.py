@@ -5,6 +5,12 @@ learner; here parallelism is expressed as `jax.sharding` over a named
 `Mesh` and XLA inserts the ICI collectives.
 """
 
+from .distributed import (
+    DistributedConfig,
+    initialize_distributed,
+    is_primary,
+    process_info,
+)
 from .sharding import (
     batch_sharding,
     replicated,
@@ -12,4 +18,13 @@ from .sharding import (
     state_shardings,
 )
 
-__all__ = ["batch_sharding", "replicated", "shard_batch", "state_shardings"]
+__all__ = [
+    "DistributedConfig",
+    "batch_sharding",
+    "initialize_distributed",
+    "is_primary",
+    "process_info",
+    "replicated",
+    "shard_batch",
+    "state_shardings",
+]

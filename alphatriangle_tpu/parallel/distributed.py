@@ -1,0 +1,87 @@
+"""Multi-host (DCN) scaffolding: jax.distributed + process-0 gating.
+
+The reference's only cross-node fabric is Ray actor RPC + the plasma
+object store (SURVEY.md §2c "Distributed communication backend"); it has
+no collectives at all. The TPU-native story: every host joins one
+`jax.distributed` cluster, the (dp, mdl) mesh spans all hosts'
+devices, and the SAME sharded-jit train step scales from one chip to a
+pod — XLA routes gradient reductions over ICI within a host and DCN
+across hosts. Host-side singleton work (TensorBoard, checkpoints,
+config dumps) runs on process 0 only.
+
+On real TPU pods `jax.distributed.initialize()` auto-discovers the
+cluster, so all fields may stay None. For CPU smoke tests (and ad-hoc
+clusters) the coordinator/process fields are explicit; see
+tests/test_distributed.py for the 2-process harness.
+"""
+
+import logging
+
+import jax
+from pydantic import BaseModel, Field, model_validator
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+class DistributedConfig(BaseModel):
+    """Cluster-membership knobs for `jax.distributed.initialize`."""
+
+    ENABLED: bool = Field(default=False)
+    # None = let JAX auto-discover (works on TPU pod slices).
+    COORDINATOR_ADDRESS: str | None = Field(default=None)
+    NUM_PROCESSES: int | None = Field(default=None, ge=1)
+    PROCESS_ID: int | None = Field(default=None, ge=0)
+
+    @model_validator(mode="after")
+    def _explicit_fields_come_together(self) -> "DistributedConfig":
+        explicit = (self.COORDINATOR_ADDRESS, self.NUM_PROCESSES, self.PROCESS_ID)
+        if any(v is not None for v in explicit) and None in explicit:
+            raise ValueError(
+                "COORDINATOR_ADDRESS, NUM_PROCESSES and PROCESS_ID must be "
+                "set together (or all left None for auto-discovery)."
+            )
+        return self
+
+
+def initialize_distributed(config: DistributedConfig | None) -> bool:
+    """Join the cluster if configured. Idempotent; returns whether this
+    process is part of a multi-process run after the call.
+
+    Must run before any JAX backend initializes (i.e. before devices are
+    touched), same constraint as `jax.distributed.initialize` itself.
+    """
+    global _initialized
+    if config is None or not config.ENABLED:
+        return jax.process_count() > 1
+    if _initialized:
+        return True
+    kwargs = {}
+    if config.COORDINATOR_ADDRESS is not None:
+        kwargs = {
+            "coordinator_address": config.COORDINATOR_ADDRESS,
+            "num_processes": config.NUM_PROCESSES,
+            "process_id": config.PROCESS_ID,
+        }
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.local_devices()),
+        len(jax.devices()),
+    )
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that owns singleton host-side work
+    (TensorBoard writes, checkpoint saves, config dumps)."""
+    return jax.process_index() == 0
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count)."""
+    return jax.process_index(), jax.process_count()

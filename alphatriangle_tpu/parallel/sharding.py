@@ -33,7 +33,34 @@ def state_shardings(mesh: Mesh, state) -> object:
 def shard_batch(mesh: Mesh, batch, dp_axis: str = "dp"):
     """Place a host batch pytree onto the mesh, sharded on `dp_axis`.
 
-    Every leaf's leading dimension must be divisible by the dp axis size.
+    Single-process: a plain sharded `device_put`; every leaf's leading
+    dimension must be divisible by the dp axis size. Multi-process
+    (mesh spans hosts): each process passes its LOCAL batch shard and
+    the leaves are assembled into global arrays — the global batch is
+    the per-process batches concatenated along the leading dim in
+    process order.
     """
     sh = batch_sharding(mesh, dp_axis)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sh, x), batch
+        )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+
+def local_rows(arr) -> "np.ndarray":
+    """This process's rows of a leading-dim-sharded global array.
+
+    Inverse of `shard_batch` for per-sample outputs (e.g. PER TD
+    errors): each host gets back exactly the rows it contributed, in
+    order, so host-local bookkeeping (priority updates) needs no
+    cross-host traffic. Single-process: the whole array.
+    """
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

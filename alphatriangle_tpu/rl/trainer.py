@@ -34,6 +34,7 @@ from ..config.train_config import TrainConfig
 from ..nn.network import NeuralNetwork
 from ..parallel.sharding import (
     batch_sharding,
+    local_rows,
     replicated,
     shard_batch,
     state_shardings,
@@ -275,15 +276,21 @@ class Trainer:
         n = int(np.asarray(batch["value_target"]).shape[0])
         if n == 0:
             return None
-        if n % self.dp_size != 0:
+        # Multi-process: `batch` is this host's share; it must tile this
+        # host's slice of the dp axis (shard_batch assembles the global
+        # array in process order).
+        local_dp = max(1, self.dp_size // jax.process_count())
+        if n % local_dp != 0:
             raise ValueError(
-                f"Batch size {n} not divisible by dp={self.dp_size}."
+                f"Local batch size {n} not divisible by the local dp "
+                f"extent {local_dp} (global dp={self.dp_size})."
             )
         device_batch = shard_batch(self.mesh, dict(batch), self.dp_axis)
         self.state, metrics, td = self._step_fn(self.state, device_batch)
         host_metrics = {k: float(v) for k, v in metrics.items()}
         host_metrics["learning_rate"] = self.get_current_lr()
-        return host_metrics, np.asarray(td)
+        # PER bookkeeping is host-local: return only this host's rows.
+        return host_metrics, local_rows(td)
 
     @property
     def global_step(self) -> int:

@@ -25,6 +25,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..config.persistence_config import PersistenceConfig
+from ..parallel.distributed import is_primary
 from ..rl.buffer import ExperienceBuffer
 
 logger = logging.getLogger(__name__)
@@ -62,7 +63,12 @@ class CheckpointManager:
         counters: dict[str, Any] | None = None,
     ) -> Path:
         """Checkpoint `train_state` (async) + counters; buffer spills go
-        through `save_buffer`. Returns the checkpoint path."""
+        through `save_buffer`. Returns the checkpoint path.
+
+        Multi-host discipline: EVERY process must call this (the Orbax
+        save is a collective over the state's global arrays); the plain
+        file writes (meta.json, pruning) happen on process 0 only.
+        """
         path = self._ckpt_dir / f"step_{step:08d}"
         if path.exists():  # overwrite-safe for forced final saves
             import shutil
@@ -70,8 +76,11 @@ class CheckpointManager:
             # An async save of this step may still be in flight; let it
             # land before removing, or the writer races the rmtree.
             self._ckptr.wait_until_finished()
-            shutil.rmtree(path, ignore_errors=True)
+            if is_primary():
+                shutil.rmtree(path, ignore_errors=True)
         self._ckptr.save(path, train_state)
+        if not is_primary():
+            return path
         meta = {"global_step": step, **(counters or {})}
         (self._ckpt_dir / f"step_{step:08d}.meta.json").write_text(
             json.dumps(meta, indent=2)
@@ -120,6 +129,10 @@ class CheckpointManager:
             logger.debug("Pruned buffer spill %s", path.name)
 
     def save_buffer(self, step: int, buffer: ExperienceBuffer) -> Path | None:
+        """Spill the (host-local) replay buffer. Multi-host: process 0
+        only — the buffer is host state, not a collective."""
+        if not is_primary():
+            return None
         state = buffer.get_state()
         if state["storage"] is None:
             return None
@@ -136,6 +149,8 @@ class CheckpointManager:
 
     def save_configs(self, configs: dict[str, Any]) -> None:
         """Dump config models to the run dir (reference README.md:79)."""
+        if not is_primary():
+            return
         out = {
             k: (v.model_dump() if hasattr(v, "model_dump") else v)
             for k, v in configs.items()

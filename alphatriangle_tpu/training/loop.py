@@ -28,6 +28,7 @@ import threading
 import time
 from enum import Enum
 
+import jax
 import numpy as np
 
 from ..profiling import ProfileSession
@@ -150,9 +151,15 @@ class TrainingLoop:
         (reference `loop.py:213-296`).
         """
         c = self.c
+        # BATCH_SIZE is the GLOBAL batch; in a multi-host run each host
+        # samples its share from its local buffer and shard_batch
+        # assembles the global array (trainer returns local TD rows).
+        local_batch = max(
+            1, self.cfg.BATCH_SIZE // jax.process_count()
+        )
         with self.profile.phase("sample"):
             sample = c.buffer.sample(
-                self.cfg.BATCH_SIZE, current_train_step=self.global_step
+                local_batch, current_train_step=self.global_step
             )
         if sample is None:
             return False

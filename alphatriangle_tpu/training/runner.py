@@ -16,6 +16,11 @@ from ..config.model_config import ModelConfig
 from ..config.persistence_config import PersistenceConfig
 from ..config.train_config import TrainConfig
 from ..logging_config import setup_logging
+from ..parallel.distributed import (
+    DistributedConfig,
+    initialize_distributed,
+    is_primary,
+)
 from ..stats.persistence import CheckpointManager
 from ..utils.helpers import enforce_platform
 from .loop import LoopStatus, TrainingLoop
@@ -55,6 +60,7 @@ def run_training(
     mcts_config: MCTSConfig | None = None,
     mesh_config: MeshConfig | None = None,
     persistence_config: PersistenceConfig | None = None,
+    distributed_config: DistributedConfig | None = None,
     log_level: str = "INFO",
     use_tensorboard: bool = True,
 ) -> int:
@@ -64,6 +70,11 @@ def run_training(
     # Must precede any backend init (a site hook can override the env
     # var and point a CPU-intended run at a possibly-wedged TPU).
     enforce_platform(train_config.DEVICE)
+    # Cluster membership must also precede backend init.
+    multi_host = initialize_distributed(distributed_config)
+    if multi_host and not is_primary():
+        # Secondary hosts run compute + collective saves, no dashboards.
+        use_tensorboard = False
     persistence_config = persistence_config or PersistenceConfig(
         RUN_NAME=train_config.RUN_NAME
     )

@@ -19,6 +19,7 @@ from ..config.validation import print_config_info_and_validate
 from ..env.engine import TriangleEnv
 from ..features.core import get_feature_extractor
 from ..nn.network import NeuralNetwork
+from ..parallel.distributed import is_primary
 from ..rl.buffer import ExperienceBuffer
 from ..rl.self_play import SelfPlayEngine
 from ..rl.trainer import Trainer
@@ -80,7 +81,11 @@ def setup_training_components(
         train_config,
         seed=train_config.RANDOM_SEED + 1,
     )
-    stats = StatsCollector(persistence_config, use_tensorboard=use_tensorboard)
+    # TensorBoard is singleton host-side work: process 0 only.
+    stats = StatsCollector(
+        persistence_config,
+        use_tensorboard=use_tensorboard and is_primary(),
+    )
     checkpoints = CheckpointManager(persistence_config)
     all_configs = {
         "env": env_config,

@@ -1,0 +1,130 @@
+"""Subprocess driver for the 2-process CPU multi-host smoke test.
+
+Launched twice by tests/test_distributed.py (process_id 0 and 1); each
+process owns 2 virtual CPU devices, so the global (dp, mdl) mesh is
+4x1 across processes. Exercises the real multi-host path end to end:
+`initialize_distributed` -> global mesh -> `Trainer.train_step` on a
+process-local batch shard (assembled into global arrays by
+`shard_batch`) -> process-0-gated checkpoint save.
+
+Prints PARAM_SUM / LOSS lines the parent asserts on: both processes
+must see identical replicated params and the same global loss.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    workdir = sys.argv[3]
+
+    from alphatriangle_tpu.parallel.distributed import (
+        DistributedConfig,
+        initialize_distributed,
+        is_primary,
+        process_info,
+    )
+
+    multi = initialize_distributed(
+        DistributedConfig(
+            ENABLED=True,
+            COORDINATOR_ADDRESS=coordinator,
+            NUM_PROCESSES=2,
+            PROCESS_ID=process_id,
+        )
+    )
+    assert multi, "initialize_distributed reported single-process"
+    idx, count = process_info()
+    assert (idx, count) == (process_id, 2)
+    assert len(jax.devices()) == 4, jax.devices()
+
+    import numpy as np
+
+    from alphatriangle_tpu.config import (
+        EnvConfig,
+        MeshConfig,
+        ModelConfig,
+        PersistenceConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+    from alphatriangle_tpu.rl import Trainer
+    from alphatriangle_tpu.stats.persistence import CheckpointManager
+
+    env_cfg = EnvConfig(
+        ROWS=3, COLS=4, PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[4],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=0,
+        USE_TRANSFORMER=False,
+        FC_DIMS_SHARED=[16],
+        POLICY_HEAD_DIMS=[16],
+        VALUE_HEAD_DIMS=[16],
+        NUM_VALUE_ATOMS=11,
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+    )
+    train_cfg = TrainConfig(
+        BATCH_SIZE=8,  # global; 4 rows per process
+        MAX_TRAINING_STEPS=10,
+        USE_PER=False,
+        RUN_NAME="dist_smoke",
+    )
+    mesh = MeshConfig().build_mesh()  # 4 global devices -> (dp=4, mdl=1)
+    assert mesh.devices.size == 4
+
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    trainer = Trainer(net, train_cfg, mesh=mesh)
+
+    # Deterministic process-local half of the global batch (4 rows each).
+    rng = np.random.default_rng(100 + process_id)
+    b = train_cfg.BATCH_SIZE // 2
+    policy = rng.random((b, env_cfg.action_dim)).astype(np.float32)
+    policy /= policy.sum(axis=1, keepdims=True)
+    batch = {
+        "grid": rng.integers(
+            -1, 2, size=(b, 1, env_cfg.ROWS, env_cfg.COLS)
+        ).astype(np.float32),
+        "other_features": rng.random(
+            (b, model_cfg.OTHER_NN_INPUT_FEATURES_DIM)
+        ).astype(np.float32),
+        "policy_target": policy,
+        "value_target": rng.uniform(-5, 5, b).astype(np.float32),
+        "weights": np.ones(b, np.float32),
+    }
+
+    losses = [trainer.train_step(batch)[0]["total_loss"] for _ in range(2)]
+    param_sum = sum(
+        float(np.asarray(leaf).sum())
+        for leaf in jax.tree_util.tree_leaves(trainer.state.params)
+    )
+    print(f"LOSS={losses[0]:.6f},{losses[1]:.6f}", flush=True)
+    print(f"PARAM_SUM={param_sum:.6f}", flush=True)
+
+    # Process-0 gating: every process calls save (Orbax-style collective
+    # discipline); only process 0 may write meta.json / prune.
+    mgr = CheckpointManager(
+        PersistenceConfig(ROOT_DATA_DIR=workdir, RUN_NAME="dist_smoke")
+    )
+    mgr.save(1, trainer.state)
+    mgr.wait_until_finished()
+    print(f"PRIMARY={int(is_primary())}", flush=True)
+    print("DIST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
