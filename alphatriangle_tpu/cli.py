@@ -186,6 +186,164 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     return analyze_profile_dir(args.profile_dir, top=args.top)
 
 
+def cmd_play(args: argparse.Namespace) -> int:
+    """Interactive text play (reference `trianglengin play/debug` CLI,
+    its README.md:199-205). Prefers the native C++ engine (instant
+    startup); falls back to the jitted JAX engine."""
+    import numpy as np
+
+    from .utils.helpers import enforce_platform
+
+    # Interactive play is host-side work; never wake the accelerator
+    # (whose init can hang on a sick chip) just to render a board.
+    enforce_platform("cpu")
+
+    from .config import EnvConfig
+    from .env.engine import TriangleEnv
+    from .env.native import native_available, native_build_error
+    from .env.render import render_grid, render_shape
+    from .env.shapes import bank_shape_triangles
+
+    env_cfg = EnvConfig()
+    env = TriangleEnv(env_cfg)
+    use_native = args.engine == "native" or (
+        args.engine == "auto" and native_available()
+    )
+    if args.engine == "native" and not native_available():
+        print(f"native engine unavailable: {native_build_error()}")
+        return 1
+
+    if use_native:
+        from .env.native import NativeTriangleEnv
+
+        native = NativeTriangleEnv(env)
+        batch = native.new_batch(1, seed=args.seed)
+
+        def state_view():
+            return (
+                env.unpack_grid_np(batch.occupied[0]),
+                batch.shape_idx[0],
+                float(batch.score[0]),
+                bool(batch.done[0]),
+            )
+
+        def do_step(action):
+            rewards, _ = native.step(
+                batch, np.asarray([action], np.int32)
+            )
+            return float(rewards[0])
+
+        def valid_mask():
+            return native.valid_mask(batch)[0]
+
+    else:
+        from .env.game_state import GameState
+
+        game = GameState(env_cfg, initial_seed=args.seed)
+
+        def state_view():
+            grid = game.get_grid_data_np()
+            hand = [
+                -1 if s is None else 0 for s in game.get_shapes()
+            ]  # display only
+            return (
+                grid["occupied"],
+                np.asarray(
+                    [
+                        -1 if s is None else i
+                        for i, s in enumerate(game.get_shapes())
+                    ]
+                ),
+                game.game_score(),
+                game.is_over(),
+            )
+
+        def do_step(action):
+            reward, _ = game.step(action)
+            return reward
+
+        def valid_mask():
+            mask = np.zeros(env_cfg.action_dim, dtype=bool)
+            mask[game.valid_actions()] = True
+            return mask
+
+    death = env.geometry.death
+    cells = env_cfg.ROWS * env_cfg.COLS
+    moves = 0
+    script = list(args.script.split(";")) if args.script else None
+    print(
+        f"Board {env_cfg.ROWS}x{env_cfg.COLS}, "
+        f"{env_cfg.NUM_SHAPE_SLOTS} shape slots, engine="
+        f"{'native' if use_native else 'jax'}."
+    )
+    print("Moves: 'SLOT ROW COL' | 'v' valid count | 'q' quit.")
+    while True:
+        occ, hand, score, done = state_view()
+        print()
+        print(render_grid(occ, death))
+        print(f"score={score:.1f}  moves={moves}")
+        for slot in range(env_cfg.NUM_SHAPE_SLOTS):
+            sidx = int(hand[slot])
+            if use_native:
+                label = (
+                    "(consumed)"
+                    if sidx < 0
+                    else "\n".join(
+                        "    " + line
+                        for line in render_shape(
+                            bank_shape_triangles(env.bank, sidx)
+                        ).splitlines()
+                    )
+                )
+            else:
+                shapes = game.get_shapes()
+                label = (
+                    "(consumed)"
+                    if shapes[slot] is None
+                    else "\n".join(
+                        "    " + line
+                        for line in render_shape(
+                            shapes[slot].triangles
+                        ).splitlines()
+                    )
+                )
+            print(f"  slot {slot}:")
+            print(label)
+        if done:
+            print("GAME OVER.")
+            return 0
+        if script is not None:
+            if not script:
+                return 0
+            line = script.pop(0).strip()
+            print(f"> {line}")
+        else:
+            try:
+                line = input("> ").strip()
+            except EOFError:
+                return 0
+        if line in ("q", "quit", "exit"):
+            return 0
+        if line == "v":
+            print(f"valid placements: {int(valid_mask().sum())}")
+            continue
+        try:
+            slot, r, c = (int(x) for x in line.split())
+            action = slot * cells + r * env_cfg.COLS + c
+        except ValueError:
+            print("Expected: SLOT ROW COL")
+            continue
+        if not 0 <= action < env_cfg.action_dim:
+            print("Out of range.")
+            continue
+        if not valid_mask()[action]:
+            print("Invalid placement (would forfeit); pick another.")
+            continue
+        reward = do_step(action)
+        moves += 1
+        print(f"reward {reward:+.1f}")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="alphatriangle-tpu",
@@ -211,6 +369,20 @@ def main(argv: list[str] | None = None) -> int:
     an.add_argument("profile_dir", help="runs/<run>/profile_data directory.")
     an.add_argument("--top", type=int, default=20)
 
+    play = sub.add_parser(
+        "play", help="Interactive text play on the default board."
+    )
+    play.add_argument("--seed", type=int, default=0)
+    play.add_argument(
+        "--engine", choices=["auto", "native", "jax"], default="auto"
+    )
+    play.add_argument(
+        "--script",
+        default=None,
+        help="Semicolon-separated scripted moves ('0 0 0;1 2 3'); "
+        "plays them then exits (demo/testing).",
+    )
+
     args = parser.parse_args(argv)
     handlers = {
         "train": cmd_train,
@@ -218,6 +390,7 @@ def main(argv: list[str] | None = None) -> int:
         "ml": cmd_ml,
         "devices": cmd_devices,
         "analyze": cmd_analyze,
+        "play": cmd_play,
     }
     return handlers[args.command](args)
 

@@ -1,0 +1,49 @@
+"""ASCII rendering of the triangle board and shapes.
+
+The reference ships an interactive pygame UI in its engine package
+(`trianglengin play/debug`, reference README.md:199-205); headless
+environments get this text twin instead. Up-pointing cells ((r + c)
+even) render as ▲/△, down-pointing as ▼/▽; death cells as a dot.
+"""
+
+import numpy as np
+
+UP_FULL, UP_EMPTY = "▲", "△"
+DOWN_FULL, DOWN_EMPTY = "▼", "▽"
+DEATH = "·"
+
+
+def render_grid(
+    occupied: np.ndarray, death: np.ndarray, color: np.ndarray | None = None
+) -> str:
+    """Multi-line board view with row/column rulers."""
+    rows, cols = occupied.shape
+    header = "    " + " ".join(f"{c % 10}" for c in range(cols))
+    lines = [header]
+    for r in range(rows):
+        cells = []
+        for c in range(cols):
+            if death[r, c]:
+                cells.append(DEATH)
+            elif (r + c) % 2 == 0:
+                cells.append(UP_FULL if occupied[r, c] else UP_EMPTY)
+            else:
+                cells.append(DOWN_FULL if occupied[r, c] else DOWN_EMPTY)
+        lines.append(f"{r:>3} " + " ".join(cells))
+    return "\n".join(lines)
+
+
+def render_shape(triangles: list[tuple[int, int, bool]]) -> str:
+    """Small standalone picture of one shape."""
+    if not triangles:
+        return "(empty)"
+    min_r = min(t[0] for t in triangles)
+    min_c = min(t[1] for t in triangles)
+    max_r = max(t[0] for t in triangles)
+    max_c = max(t[1] for t in triangles)
+    grid = [
+        [" "] * (max_c - min_c + 1) for _ in range(max_r - min_r + 1)
+    ]
+    for r, c, is_up in triangles:
+        grid[r - min_r][c - min_c] = UP_FULL if is_up else DOWN_FULL
+    return "\n".join(" ".join(row).rstrip() for row in grid)

@@ -100,6 +100,17 @@ class ShapeBank:
         return int(self.tri_r.shape[1])
 
 
+def bank_shape_triangles(
+    bank: ShapeBank, shape_idx: int
+) -> list[tuple[int, int, bool]]:
+    """One shape's (r, c, is_up) triangle list (reference
+    `trianglengin.Shape.triangles` surface)."""
+    return [
+        (int(r), int(c), _is_up(int(r), int(c)))
+        for r, c in bank.shapes[shape_idx]
+    ]
+
+
 def build_shape_bank(cfg: EnvConfig) -> ShapeBank:
     """Enumerate and densify the shape bank for a config."""
     shapes = enumerate_shapes(cfg.MIN_SHAPE_TRIANGLES, cfg.MAX_SHAPE_TRIANGLES)
