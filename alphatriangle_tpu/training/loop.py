@@ -140,6 +140,18 @@ class TrainingLoop:
                     global_step=step,
                 ),
             ]
+        trace = getattr(c.self_play, "last_trace", None)
+        if trace is not None and "wasted_slots" in trace:
+            # Orphan node slots per search (docs/MCTS_DESIGN.md §c) —
+            # keeps the wave-expansion waste visible in TensorBoard.
+            events.append(
+                RawMetricEvent(
+                    name="SelfPlay/Wasted_Slot_Fraction",
+                    value=float(np.mean(trace["wasted_slots"]))
+                    / c.self_play.mcts_config.max_simulations,
+                    global_step=step,
+                )
+            )
         c.stats.log_batch_events(events)
         self.experiences_added += result.num_experiences
         return result.num_experiences
