@@ -186,6 +186,127 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     return analyze_profile_dir(args.profile_dir, top=args.top)
 
 
+def cmd_eval(args: argparse.Namespace) -> int:
+    """Arena evaluation: greedy-MCTS play from a checkpoint, with a
+    uniform-random baseline (the reference evaluates strength only via
+    training-run score metrics; this makes it a standalone command)."""
+    import json as _json
+
+    import numpy as np
+
+    from .utils.helpers import enforce_platform
+
+    enforce_platform(args.device or "auto")
+
+    import jax
+    import jax.numpy as jnp
+
+    from .config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        PersistenceConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+    from .env.engine import TriangleEnv
+    from .features.core import get_feature_extractor
+    from .mcts import BatchedMCTS
+    from .nn.network import NeuralNetwork
+    from .rl import Trainer
+    from .stats.persistence import CheckpointManager
+
+    env_cfg = EnvConfig()
+    model_cfg = ModelConfig(
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg)
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    train_cfg = TrainConfig(RUN_NAME=args.run_name or "eval")
+
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+
+    source = "untrained"
+    if args.checkpoint or args.run_name:
+        trainer = Trainer(net, train_cfg)
+        if args.checkpoint:
+            persistence = PersistenceConfig(RUN_NAME="eval_tmp")
+            if args.root_dir:
+                persistence = persistence.model_copy(
+                    update={"ROOT_DATA_DIR": args.root_dir}
+                )
+            mgr = CheckpointManager(persistence)
+            loaded = mgr.restore_path(args.checkpoint, trainer.state)
+        else:
+            persistence = PersistenceConfig(RUN_NAME=args.run_name)
+            if args.root_dir:
+                persistence = persistence.model_copy(
+                    update={"ROOT_DATA_DIR": args.root_dir}
+                )
+            mgr = CheckpointManager(persistence)
+            loaded = mgr.restore(trainer.state)
+        if loaded.train_state is None:
+            print("No checkpoint found; evaluating the untrained net.")
+        else:
+            trainer.set_state(loaded.train_state)
+            trainer.sync_to_network()
+            source = f"step {loaded.global_step}"
+
+    mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
+    B = args.games
+    rng = np.random.default_rng(args.seed)
+
+    def play(policy_fn):
+        states = env.reset_batch(
+            jax.random.split(jax.random.PRNGKey(args.seed), B)
+        )
+        for move in range(args.max_moves):
+            done = np.asarray(states.done)
+            if done.all():
+                break
+            actions = policy_fn(states, move)
+            states, _, _ = env.step_batch(
+                states, jnp.asarray(actions, dtype=jnp.int32)
+            )
+        return (
+            np.asarray(states.score),
+            np.asarray(states.step_count),
+            np.asarray(states.done),
+        )
+
+    def mcts_policy(states, move):
+        out = mcts.search(
+            net.variables, states, jax.random.PRNGKey(7000 + move)
+        )
+        counts = np.asarray(out.visit_counts)
+        return np.where(counts.sum(axis=1) > 0, counts.argmax(axis=1), 0)
+
+    def random_policy(states, move):
+        masks = np.asarray(env.valid_mask_batch(states))
+        logits = np.where(masks, rng.random(masks.shape), -np.inf)
+        return np.where(masks.any(axis=1), logits.argmax(axis=1), 0)
+
+    print(f"Evaluating {source} net: {B} games, {args.sims} sims/move...")
+    scores, lengths, done = play(mcts_policy)
+    r_scores, r_lengths, _ = play(random_policy)
+    report = {
+        "source": source,
+        "games": B,
+        "sims": args.sims,
+        "mcts_mean_score": round(float(scores.mean()), 2),
+        "mcts_max_score": round(float(scores.max()), 2),
+        "mcts_mean_length": round(float(lengths.mean()), 1),
+        "finished_fraction": round(float(done.mean()), 3),
+        "random_mean_score": round(float(r_scores.mean()), 2),
+        "score_vs_random": round(
+            float(scores.mean() / max(r_scores.mean(), 1e-9)), 3
+        ),
+    }
+    print(_json.dumps(report))
+    return 0
+
+
 def cmd_play(args: argparse.Namespace) -> int:
     """Interactive text play (reference `trianglengin play/debug` CLI,
     its README.md:199-205). Prefers the native C++ engine (instant
@@ -369,6 +490,20 @@ def main(argv: list[str] | None = None) -> int:
     an.add_argument("profile_dir", help="runs/<run>/profile_data directory.")
     an.add_argument("--top", type=int, default=20)
 
+    ev = sub.add_parser(
+        "eval", help="Arena evaluation of a checkpoint (greedy MCTS play)."
+    )
+    ev.add_argument("--checkpoint", default=None, metavar="PATH")
+    ev.add_argument("--run-name", default=None)
+    ev.add_argument("--root-dir", default=None)
+    ev.add_argument("--games", type=int, default=64)
+    ev.add_argument("--sims", type=int, default=64)
+    ev.add_argument("--max-moves", type=int, default=200)
+    ev.add_argument("--seed", type=int, default=0)
+    ev.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+
     play = sub.add_parser(
         "play", help="Interactive text play on the default board."
     )
@@ -390,6 +525,7 @@ def main(argv: list[str] | None = None) -> int:
         "ml": cmd_ml,
         "devices": cmd_devices,
         "analyze": cmd_analyze,
+        "eval": cmd_eval,
         "play": cmd_play,
     }
     return handlers[args.command](args)

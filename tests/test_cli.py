@@ -74,6 +74,32 @@ class TestTrainOverrides:
         assert tc.AUTO_RESUME_LATEST is True
         assert captured["persistence_config"] is None
 
+    def test_distributed_flags(self, monkeypatch):
+        captured = self._capture(monkeypatch)
+        assert (
+            cli.main(
+                [
+                    "train", "--run-name", "r",
+                    "--coordinator", "host0:1234",
+                    "--num-processes", "2",
+                    "--process-id", "1",
+                ]
+            )
+            == 0
+        )
+        dc = captured["distributed_config"]
+        assert dc.ENABLED and dc.COORDINATOR_ADDRESS == "host0:1234"
+        assert (dc.NUM_PROCESSES, dc.PROCESS_ID) == (2, 1)
+
+        captured = self._capture(monkeypatch)
+        assert cli.main(["train", "--run-name", "r", "--distributed"]) == 0
+        dc = captured["distributed_config"]
+        assert dc.ENABLED and dc.COORDINATOR_ADDRESS is None
+
+        captured = self._capture(monkeypatch)
+        assert cli.main(["train", "--run-name", "r"]) == 0
+        assert captured["distributed_config"] is None
+
     def test_invalid_override_fails_fast(self, monkeypatch):
         self._capture(monkeypatch)
         with pytest.raises(Exception):
@@ -110,6 +136,16 @@ class TestAuxCommands:
 
     def test_analyze_missing_dir(self, tmp_path, capsys):
         assert cli.main(["analyze", str(tmp_path / "nope")]) == 1
+
+    def test_play_scripted(self, capsys):
+        from alphatriangle_tpu.env.native import native_available
+
+        if not native_available():
+            pytest.skip("native engine unavailable")
+        assert cli.main(["play", "--script", "v;q"]) == 0
+        out = capsys.readouterr().out
+        assert "engine=native" in out
+        assert "valid placements:" in out
 
     def test_requires_subcommand(self):
         with pytest.raises(SystemExit):
