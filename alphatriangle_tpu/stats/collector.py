@@ -33,6 +33,17 @@ except Exception:  # pragma: no cover
     SummaryWriter = None
 
 
+def _import_mlflow():
+    """mlflow is an optional dependency (absent from this image); the
+    collector mirrors to it only when importable AND a tracking URI is
+    configured (reference logs to MLflow + TB, its README.md:63-79)."""
+    try:
+        import mlflow
+    except Exception:
+        return None
+    return mlflow
+
+
 class StatsCollector:
     """Aggregates raw metric events; writes means per tick to TensorBoard."""
 
@@ -60,6 +71,28 @@ class StatsCollector:
             if tb_dir is not None:
                 tb_dir.mkdir(parents=True, exist_ok=True)
                 self._writer = SummaryWriter(str(tb_dir))
+        self._mlflow = None
+        self._mlflow_run = None
+        uri = persistence.MLFLOW_TRACKING_URI if persistence else None
+        if uri:
+            mlflow = _import_mlflow()
+            if mlflow is None:
+                logger.warning(
+                    "MLFLOW_TRACKING_URI set but mlflow is not installed; "
+                    "TensorBoard-only."
+                )
+            else:
+                try:
+                    mlflow.set_tracking_uri(uri)
+                    run_name = (
+                        persistence.RUN_NAME if persistence else "run"
+                    )
+                    self._mlflow_run = mlflow.start_run(run_name=run_name)
+                    self._mlflow = mlflow
+                except Exception:
+                    logger.exception(
+                        "MLflow init failed; TensorBoard-only."
+                    )
 
     # --- ingestion (cheap, any thread) ------------------------------------
 
@@ -98,6 +131,14 @@ class StatsCollector:
                 self._writer.add_scalar(name, mean, global_step)
         if self._writer is not None and means:
             self._writer.flush()
+        if self._mlflow is not None and means:
+            try:
+                self._mlflow.log_metrics(
+                    {k.replace("/", "."): v for k, v in means.items()},
+                    step=global_step,
+                )
+            except Exception:  # metrics are best-effort, never fatal
+                logger.exception("MLflow log_metrics failed")
         return means
 
     def force_process_and_log(self, global_step: int) -> dict[str, float]:
@@ -113,15 +154,22 @@ class StatsCollector:
         (`training/logging_utils.py:13-35`); MLflow is absent here so
         params land as one markdown text card per config model.
         """
-        if self._writer is None:
-            return
         for name, cfg in configs.items():
             payload = cfg.model_dump() if hasattr(cfg, "model_dump") else cfg
-            text = "```json\n" + json.dumps(
-                payload, indent=2, default=str
-            ) + "\n```"
-            self._writer.add_text(f"config/{name}", text, 0)
-        self._writer.flush()
+            if self._writer is not None:
+                text = "```json\n" + json.dumps(
+                    payload, indent=2, default=str
+                ) + "\n```"
+                self._writer.add_text(f"config/{name}", text, 0)
+            if self._mlflow is not None and isinstance(payload, dict):
+                try:
+                    self._mlflow.log_params(
+                        {f"{name}.{k}": str(v) for k, v in payload.items()}
+                    )
+                except Exception:
+                    logger.exception("MLflow log_params failed")
+        if self._writer is not None:
+            self._writer.flush()
 
     # --- introspection ----------------------------------------------------
 
@@ -137,3 +185,10 @@ class StatsCollector:
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        if self._mlflow is not None:
+            try:
+                self._mlflow.end_run()
+            except Exception:
+                logger.exception("MLflow end_run failed")
+            self._mlflow = None
+            self._mlflow_run = None

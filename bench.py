@@ -195,6 +195,51 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     learner_steps_per_sec = n_steps / (time.time() - t0)
     log(f"bench: learner {learner_steps_per_sec:.2f} steps/s (batch {b})")
 
+    # --- overlapped producer/consumer (combined rates) ------------------
+    # The phases above run each side alone; this measures both at once
+    # (the training loop's ASYNC_ROLLOUTS topology): a producer thread
+    # drives self-play chunks while the main thread trains.
+    import threading
+
+    overlap_seconds = 5.0 if smoke else min(40.0, seconds)
+    engine.harvest()  # reset counters
+    stop = threading.Event()
+    produced = {"moves": 0, "error": None}
+
+    def producer() -> None:
+        try:
+            while not stop.is_set():
+                engine.play_chunk()
+                produced["moves"] += chunk
+        except Exception as exc:  # surface, don't hang the bench
+            produced["error"] = f"{type(exc).__name__}: {exc}"
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    t0 = time.time()
+    o_steps = 0
+    while time.time() - t0 < overlap_seconds:
+        trainer.train_step(batch)
+        o_steps += 1
+    jax.block_until_ready(trainer.state.params)
+    stop.set()
+    th.join(timeout=120)
+    o_elapsed = time.time() - t0
+    o_result = engine.harvest()
+    overlapped = {
+        "seconds": round(o_elapsed, 1),
+        "games_per_hour": round(
+            o_result.num_episodes / o_elapsed * 3600.0, 1
+        ),
+        "moves_per_sec": round(
+            produced["moves"] * sp_batch / o_elapsed, 1
+        ),
+        "learner_steps_per_sec": round(o_steps / o_elapsed, 2),
+    }
+    if produced["error"]:
+        overlapped["producer_error"] = produced["error"]
+    log(f"bench: overlapped {overlapped}")
+
     north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)
     return {
         "metric": "self_play_games_per_hour",
@@ -219,6 +264,7 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "learner_steps_per_sec": round(learner_steps_per_sec, 2),
             "learner_batch": b,
             "first_chunk_compile_seconds": round(compile_s, 1),
+            "overlapped": overlapped,
         },
     }
 

@@ -60,6 +60,42 @@ class TestCollector:
         files = list((tmp_path / "tb").glob("events.out.tfevents.*"))
         assert files and files[0].stat().st_size > 0
 
+    def test_mlflow_mirroring_when_available(self, tmp_path, monkeypatch):
+        """With a tracking URI configured and mlflow importable, metrics
+        and params mirror to it (absent mlflow degrades to TB-only)."""
+        import sys
+        import types
+
+        calls = {"metrics": [], "params": [], "runs": 0, "ended": 0}
+        fake = types.ModuleType("mlflow")
+        fake.set_tracking_uri = lambda uri: calls.setdefault("uri", uri)
+        fake.start_run = lambda run_name=None: (
+            calls.__setitem__("runs", calls["runs"] + 1) or object()
+        )
+        fake.log_metrics = lambda m, step=None: calls["metrics"].append(
+            (m, step)
+        )
+        fake.log_params = lambda p: calls["params"].append(p)
+        fake.end_run = lambda: calls.__setitem__(
+            "ended", calls["ended"] + 1
+        )
+        monkeypatch.setitem(sys.modules, "mlflow", fake)
+
+        cfg = PersistenceConfig(
+            ROOT_DATA_DIR=str(tmp_path),
+            RUN_NAME="ml_run",
+            MLFLOW_TRACKING_URI="file:///tmp/mlruns",
+        )
+        col = StatsCollector(cfg)
+        assert calls["runs"] == 1 and calls["uri"] == "file:///tmp/mlruns"
+        col.log_scalar("Loss/Total", 2.0, step=3)
+        col.process_and_log(3)
+        assert calls["metrics"] == [({"Loss.Total": 2.0}, 3)]
+        col.log_params({"train": {"BATCH_SIZE": 8}})
+        assert calls["params"] == [{"train.BATCH_SIZE": "8"}]
+        col.close()
+        assert calls["ended"] == 1
+
 
 def per_cfg(tmp_path, run="run_a") -> PersistenceConfig:
     return PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME=run)
