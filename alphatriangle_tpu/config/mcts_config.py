@@ -36,6 +36,11 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # then collapse onto one leaf; the duplicate shows up in
     # `SearchOutput.wasted_slots`).
     wave_noise_scale: float = Field(default=0.25, ge=0)
+    # How descent reads tree rows: "einsum" (one-hot matmul on the
+    # MXU), "pallas" (custom VMEM row-copy kernel, ops/gather_rows.py),
+    # or "take" (XLA native gather). Numerically identical; a pure
+    # performance knob to be settled by on-hardware benchmarks.
+    descent_gather: str = Field(default="einsum", pattern="^(einsum|pallas|take)$")
 
     @model_validator(mode="after")
     def _warn_depth(self) -> "AlphaTriangleMCTSConfig":

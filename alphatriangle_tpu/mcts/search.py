@@ -54,6 +54,7 @@ from flax import struct
 from ..config.mcts_config import MCTSConfig
 from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
+from ..ops import gather_rows
 
 
 @struct.dataclass
@@ -200,8 +201,6 @@ class BatchedMCTS:
         cfg = self.config
         w, a = self.wave_size, self.action_dim
         depth = cfg.max_depth
-        n = self.num_nodes
-        iota_n = jnp.arange(n, dtype=jnp.int32)
 
         # Per-wave dense stat block: one (B, N, 6A) tensor so each
         # descent level is a single batched matmul row-read.
@@ -219,13 +218,9 @@ class BatchedMCTS:
 
         def level(d, carry):
             node, action, stop, rec_node, rec_action, rec_reward, rec_active = carry
-            node_oh = (node[..., None] == iota_n).astype(jnp.float32)  # (B,W,N)
-            rows = jnp.einsum(
-                "bwn,bnk->bwk",
-                node_oh,
-                stats,
-                precision=jax.lax.Precision.HIGHEST,
-            )  # (B, W, 6A) — exact f32 row select on the MXU
+            # (B, W, 6A) exact row select; lowering per config (one-hot
+            # MXU matmul / Pallas VMEM copy / XLA gather).
+            rows = gather_rows(stats, node, mode=cfg.descent_gather)
             visits_r = rows[..., 0 * a : 1 * a]
             value_r = rows[..., 1 * a : 2 * a]
             reward_r = rows[..., 2 * a : 3 * a]

@@ -1,0 +1,5 @@
+"""Custom TPU ops (Pallas kernels with portable fallbacks)."""
+
+from .gather_rows import gather_rows
+
+__all__ = ["gather_rows"]

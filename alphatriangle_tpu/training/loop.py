@@ -142,16 +142,28 @@ class TrainingLoop:
             ]
         trace = getattr(c.self_play, "last_trace", None)
         if trace is not None and "wasted_slots" in trace:
-            # Orphan node slots per search (docs/MCTS_DESIGN.md §c) —
-            # keeps the wave-expansion waste visible in TensorBoard.
-            events.append(
+            # Per-move diagnostics, chunk-aggregated (the reference's
+            # per-move mcts_step/step_reward events, `worker.py:141-164`,
+            # at per-chunk granularity). Wasted slots per
+            # docs/MCTS_DESIGN.md §c.
+            events += [
                 RawMetricEvent(
                     name="SelfPlay/Wasted_Slot_Fraction",
                     value=float(np.mean(trace["wasted_slots"]))
                     / c.self_play.mcts_config.max_simulations,
                     global_step=step,
-                )
-            )
+                ),
+                RawMetricEvent(
+                    name="SelfPlay/Step_Reward",
+                    value=float(np.mean(trace["reward"])),
+                    global_step=step,
+                ),
+                RawMetricEvent(
+                    name="SelfPlay/Root_Value",
+                    value=float(np.mean(trace["root_value"])),
+                    global_step=step,
+                ),
+            ]
         c.stats.log_batch_events(events)
         self.experiences_added += result.num_experiences
         return result.num_experiences

@@ -127,7 +127,12 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
         COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
     )
-    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=sims, max_depth=depth)
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=sims,
+        max_depth=depth,
+        # A/B knob for the descent row-gather lowering (ops/gather_rows.py).
+        descent_gather=os.environ.get("BENCH_GATHER", "einsum"),
+    )
     train_cfg = TrainConfig(
         SELF_PLAY_BATCH_SIZE=sp_batch,
         ROLLOUT_CHUNK_MOVES=chunk,

@@ -26,6 +26,12 @@ import jax  # noqa: E402
 # re-assert CPU at the config layer before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 
+# The suite's runtime is dominated by jit compiles of near-identical
+# programs; the persistent compilation cache cuts repeat full-suite runs
+# by several minutes. Safe across processes (cache writes are atomic).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
