@@ -1,0 +1,147 @@
+// Sanitizer fuzz harness for the native engine (SURVEY.md §5: the
+// reference has no sanitizer coverage at all; its C++ deps are opaque
+// prebuilt wheels. Here the native engine gets an ASAN/UBSan-compiled
+// random-playout fuzz run in the test suite).
+//
+// Built by tests/test_native_engine.py as:
+//   g++ -O1 -g -fsanitize=address,undefined -std=c++17 \
+//       fuzz_main.cpp engine.cpp -o fuzz && ./fuzz <table_dump>
+// The table dump (little-endian header + uint32 tables) is written by
+// the test from the SAME Python-built bitboard tables the real engine
+// uses, so the fuzz exercises production geometry.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+void* at_create(int rows, int cols, int slots, int n_shapes, int nw,
+                int n_lines, int n_colors, float reward_placed,
+                float reward_cleared, float penalty_game_over,
+                const uint32_t* fp, const uint32_t* lines);
+void at_destroy(void* ptr);
+void at_valid_mask(const void* ptr, int n, const uint32_t* occ,
+                   const int32_t* hand, const uint8_t* done, uint8_t* out);
+void at_step(const void* ptr, int n, int refill, uint32_t* occ, int8_t* color,
+             int32_t* hand, int8_t* hand_color, const int32_t* actions,
+             uint64_t* rng, float* rewards, uint8_t* done, float* score,
+             int32_t* step_count, int32_t* last_cleared);
+}
+
+static uint64_t rng_state = 0x853c49e6748fea9bULL;
+static uint32_t rnd() {
+  rng_state ^= rng_state >> 12;
+  rng_state ^= rng_state << 25;
+  rng_state ^= rng_state >> 27;
+  return static_cast<uint32_t>((rng_state * 0x2545F4914F6CDD1DULL) >> 32);
+}
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: fuzz <table_dump>\n");
+    return 2;
+  }
+  FILE* f = std::fopen(argv[1], "rb");
+  if (!f) {
+    std::perror("open");
+    return 2;
+  }
+  int32_t hdr[7];  // rows cols slots n_shapes nw n_lines n_colors
+  if (std::fread(hdr, sizeof(int32_t), 7, f) != 7) return 2;
+  const int rows = hdr[0], cols = hdr[1], slots = hdr[2], n_shapes = hdr[3],
+            nw = hdr[4], n_lines = hdr[5], n_colors = hdr[6];
+  const int cells = rows * cols, action_dim = slots * cells;
+  std::vector<uint32_t> fp(static_cast<size_t>(n_shapes) * cells * (nw + 1));
+  std::vector<uint32_t> lines(static_cast<size_t>(n_lines) * nw);
+  if (std::fread(fp.data(), sizeof(uint32_t), fp.size(), f) != fp.size())
+    return 2;
+  if (n_lines &&
+      std::fread(lines.data(), sizeof(uint32_t), lines.size(), f) !=
+          lines.size())
+    return 2;
+  std::fclose(f);
+
+  void* eng = at_create(rows, cols, slots, n_shapes, nw, n_lines, n_colors,
+                        1.0f, 2.0f, -10.0f, fp.data(), lines.data());
+
+  const int N = 64, GAMES = 40, MAX_MOVES = 300;
+  for (int round_i = 0; round_i < GAMES; ++round_i) {
+    std::vector<uint32_t> occ(N * nw, 0);
+    std::vector<int8_t> color(N * cells, -1);
+    std::vector<int32_t> hand(N * slots);
+    std::vector<int8_t> hand_color(N * slots, 0);
+    std::vector<uint64_t> rng(N);
+    std::vector<float> rewards(N, 0), score(N, 0);
+    std::vector<uint8_t> done(N, 0);
+    std::vector<int32_t> step_count(N, 0), last_cleared(N, 0);
+    std::vector<uint8_t> mask(static_cast<size_t>(N) * action_dim);
+    std::vector<int32_t> actions(N);
+    for (int g = 0; g < N; ++g) {
+      rng[g] = rng_state + g * 977;
+      for (int s = 0; s < slots; ++s)
+        hand[g * slots + s] = static_cast<int32_t>(rnd() % n_shapes);
+    }
+    for (int move = 0; move < MAX_MOVES; ++move) {
+      at_valid_mask(eng, N, occ.data(), hand.data(), done.data(), mask.data());
+      bool all_done = true;
+      for (int g = 0; g < N; ++g) {
+        if (done[g]) {
+          actions[g] = 0;
+          continue;
+        }
+        all_done = false;
+        // Mostly-valid actions, occasionally invalid / out-of-range to
+        // fuzz the forfeit path.
+        const uint32_t dice = rnd() % 100;
+        if (dice < 5) {
+          actions[g] = static_cast<int32_t>(rnd() % (2 * action_dim)) -
+                       action_dim / 2;
+          continue;
+        }
+        const uint8_t* gm = mask.data() + static_cast<size_t>(g) * action_dim;
+        int count = 0;
+        for (int a2 = 0; a2 < action_dim; ++a2) count += gm[a2];
+        if (count == 0) {
+          actions[g] = 0;
+          continue;
+        }
+        int pick = static_cast<int>(rnd() % count);
+        int chosen = 0;
+        for (int a2 = 0; a2 < action_dim; ++a2) {
+          if (gm[a2] && pick-- == 0) {
+            chosen = a2;
+            break;
+          }
+        }
+        actions[g] = chosen;
+      }
+      if (all_done) break;
+      at_step(eng, N, /*refill=*/1, occ.data(), color.data(), hand.data(),
+              hand_color.data(), actions.data(), rng.data(), rewards.data(),
+              done.data(), score.data(), step_count.data(),
+              last_cleared.data());
+      // Invariants the sanitizers can't see.
+      for (int g = 0; g < N; ++g) {
+        if (last_cleared[g] < 0 || last_cleared[g] > cells) {
+          std::fprintf(stderr, "bad last_cleared %d\n", last_cleared[g]);
+          return 1;
+        }
+        for (int c2 = 0; c2 < cells; ++c2) {
+          const bool occupied =
+              (occ[g * nw + c2 / 32] >> (c2 % 32)) & 1u;
+          const bool colored = color[g * cells + c2] >= 0;
+          if (occupied != colored) {
+            std::fprintf(stderr, "occ/color desync at game %d cell %d\n", g,
+                         c2);
+            return 1;
+          }
+        }
+      }
+    }
+  }
+  at_destroy(eng);
+  std::puts("FUZZ_OK");
+  return 0;
+}

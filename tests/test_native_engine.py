@@ -173,6 +173,60 @@ class TestParity:
         assert not native.valid_mask(batch).any()
 
 
+@pytest.mark.slow
+def test_asan_ubsan_fuzz(world, tmp_path):
+    """Random-playout fuzz of the C++ engine under ASAN+UBSan, plus
+    occ/color-sync invariants — sanitizer coverage the reference's
+    prebuilt C++ wheels never had (SURVEY.md §5)."""
+    import struct
+    import subprocess
+
+    env, native = world
+    src_dir = (
+        __import__("pathlib").Path(
+            __import__("alphatriangle_tpu.env.native", fromlist=["x"]).__file__
+        ).parent
+    )
+    dump = tmp_path / "tables.bin"
+    with dump.open("wb") as f:
+        f.write(
+            struct.pack(
+                "<7i",
+                native.rows,
+                native.cols,
+                native.num_slots,
+                native.n_shapes,
+                native.num_words,
+                native._lines.shape[0],
+                env.cfg.NUM_COLORS,
+            )
+        )
+        f.write(np.ascontiguousarray(native._fp, np.uint32).tobytes())
+        f.write(np.ascontiguousarray(native._lines, np.uint32).tobytes())
+
+    binary = tmp_path / "fuzz"
+    compile_proc = subprocess.run(
+        [
+            "g++", "-O1", "-g", "-std=c++17",
+            "-fsanitize=address,undefined",
+            "-fno-sanitize-recover=all",
+            str(src_dir / "fuzz_main.cpp"),
+            str(src_dir / "engine.cpp"),
+            "-o", str(binary),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    if compile_proc.returncode != 0:
+        pytest.skip(f"sanitizer build unavailable: {compile_proc.stderr[-300:]}")
+    run = subprocess.run(
+        [str(binary), str(dump)], capture_output=True, text=True, timeout=300
+    )
+    assert run.returncode == 0, f"fuzz failed:\n{run.stdout}\n{run.stderr}"
+    assert "FUZZ_OK" in run.stdout
+
+
 class TestNativeRollout:
     def test_full_games_terminate_with_refills(self, world):
         """Self-contained native rollout: uniform-random play with
