@@ -290,6 +290,12 @@ def cmd_eval(args: argparse.Namespace) -> int:
     print(f"Evaluating {source} net: {B} games, {args.sims} sims/move...")
     scores, lengths, done = play(mcts_policy)
     r_scores, r_lengths, _ = play(random_policy)
+    # Both policies start from the SAME reset keys, and hand draws
+    # depend only on the step index (the key chain splits every step
+    # regardless of action), so game i sees the same shape sequence
+    # under both policies: the comparison is PAIRED, which strips the
+    # hand-luck variance that dominates this game.
+    diffs = scores - r_scores
     report = {
         "source": source,
         "games": B,
@@ -301,6 +307,10 @@ def cmd_eval(args: argparse.Namespace) -> int:
         "random_mean_score": round(float(r_scores.mean()), 2),
         "score_vs_random": round(
             float(scores.mean() / max(r_scores.mean(), 1e-9)), 3
+        ),
+        "paired_mean_diff": round(float(diffs.mean()), 3),
+        "paired_win_rate": round(
+            float((diffs > 0).mean() + 0.5 * (diffs == 0).mean()), 3
         ),
     }
     print(_json.dumps(report))

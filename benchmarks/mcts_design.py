@@ -237,6 +237,28 @@ def main() -> None:
             print(f"(c') flagship wave={wave}: {fwaste[wave]}", flush=True)
         results["wasted_slot_fraction_by_wave_flagship"] = fwaste
 
+        # (c'') wave_noise_scale sweep at wave=32: the knob that trades
+        # descent diversity (fewer duplicate edges) against PUCT
+        # fidelity (noise perturbs the argmax).
+        nsweep = {}
+        for noise in (0.0, 0.1, 0.25, 0.5, 1.0):
+            cfg = AlphaTriangleMCTSConfig(
+                max_simulations=64,
+                max_depth=8,
+                mcts_batch_size=32,
+                wave_noise_scale=noise,
+            )
+            mcts = BatchedMCTS(f_env, f_fe, f_net.model, cfg, f_net.support)
+            score, frac, _ = rollout(
+                f_env, f_fe, f_net, mcts, 400, b=8, max_moves=12
+            )
+            nsweep[str(noise)] = {
+                "wasted_frac": round(frac, 4),
+                "mean_score_12_moves": round(score, 2),
+            }
+            print(f"(c'') noise={noise}: {nsweep[str(noise)]}", flush=True)
+        results["flagship_noise_sweep_wave32"] = nsweep
+
     out_path = Path(__file__).parent / "mcts_design_results.json"
     out_path.write_text(json.dumps(results, indent=2))
     print(json.dumps(results))
