@@ -42,6 +42,26 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--buffer-capacity", type=int, default=None)
     p.add_argument("--min-buffer", type=int, default=None)
     p.add_argument("--rollout-chunk", type=int, default=None)
+    p.add_argument(
+        "--fused-learner-steps",
+        type=int,
+        default=None,
+        metavar="K",
+        help="Learner steps fused into one device dispatch (1 = exact "
+        "per-step PER semantics; >1 collapses host round trips).",
+    )
+    p.add_argument(
+        "--async-rollouts",
+        action="store_true",
+        help="Overlapped mode: self-play producer thread + replay-ratio"
+        "-gated learner (see --replay-ratio).",
+    )
+    p.add_argument(
+        "--replay-ratio",
+        type=float,
+        default=None,
+        help="Async mode: samples consumed per experience produced.",
+    )
     p.add_argument("--no-per", action="store_true")
     p.add_argument(
         "--no-auto-resume",
@@ -93,6 +113,12 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["MIN_BUFFER_SIZE_TO_TRAIN"] = args.min_buffer
     if args.rollout_chunk is not None:
         overrides["ROLLOUT_CHUNK_MOVES"] = args.rollout_chunk
+    if args.fused_learner_steps is not None:
+        overrides["FUSED_LEARNER_STEPS"] = args.fused_learner_steps
+    if args.async_rollouts:
+        overrides["ASYNC_ROLLOUTS"] = True
+    if args.replay_ratio is not None:
+        overrides["REPLAY_RATIO"] = args.replay_ratio
     if args.no_per:
         overrides["USE_PER"] = False
     if args.no_auto_resume:

@@ -66,6 +66,15 @@ class TrainConfig(BaseModel):
 
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)
+    # Learner steps fused into ONE device dispatch (a lax.scan over
+    # pre-sampled batches). 1 = exact reference semantics (PER
+    # priorities update between consecutive steps). >1 trades bounded
+    # priority staleness (< FUSED_LEARNER_STEPS steps) for one host
+    # round trip per group instead of per step — the difference between
+    # ~2 and >100 steps/s when the accelerator sits behind a network
+    # tunnel, and what lets the learner keep pace with multi-second
+    # self-play chunks on a single shared chip.
+    FUSED_LEARNER_STEPS: int = Field(default=1, ge=1)
     BUFFER_CAPACITY: int = Field(default=250_000, ge=1)
     MIN_BUFFER_SIZE_TO_TRAIN: int = Field(default=25_000, ge=1)
 

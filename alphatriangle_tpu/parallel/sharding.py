@@ -48,19 +48,20 @@ def shard_batch(mesh: Mesh, batch, dp_axis: str = "dp"):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
 
 
-def local_rows(arr) -> "np.ndarray":
-    """This process's rows of a leading-dim-sharded global array.
+def local_rows(arr, axis: int = 0) -> "np.ndarray":
+    """This process's rows of an `axis`-sharded global array.
 
     Inverse of `shard_batch` for per-sample outputs (e.g. PER TD
     errors): each host gets back exactly the rows it contributed, in
     order, so host-local bookkeeping (priority updates) needs no
-    cross-host traffic. Single-process: the whole array.
+    cross-host traffic. Single-process: the whole array. `axis` is the
+    batch-sharded dimension (1 for stacked fused-step outputs (K, B)).
     """
     import numpy as np
 
     if jax.process_count() == 1:
         return np.asarray(arr)
     shards = sorted(
-        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        arr.addressable_shards, key=lambda s: s.index[axis].start or 0
     )
-    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=axis)

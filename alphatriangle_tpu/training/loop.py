@@ -61,6 +61,8 @@ class TrainingLoop:
         self._steps_this_run = 0
         self._producer_error: BaseException | None = None
         self._last_saved_step: int | None = None
+        self._last_buffer_saved_step: int | None = None
+        self._cadence_anchor = 0  # resume step; cadence baseline
         self._last_progress_time = time.monotonic()
         self._last_progress_step = 0
         # Per-phase timers always run (ns-level overhead); the device
@@ -81,6 +83,7 @@ class TrainingLoop:
         self.episodes_played = episodes_played
         self.total_simulations = total_simulations
         self._last_progress_step = global_step
+        self._cadence_anchor = global_step
 
     # --- iteration pieces -------------------------------------------------
 
@@ -168,35 +171,17 @@ class TrainingLoop:
         self.experiences_added += result.num_experiences
         return result.num_experiences
 
-    def _run_training_step(self) -> bool:
-        """One sample -> train -> priority-update -> maybe sync cycle.
+    def _record_step(self, metrics: dict, td_errors, indices, step: int) -> None:
+        """Per-learner-step bookkeeping: priorities, counters, events.
 
-        Returns False when the buffer could not produce a batch
-        (reference `loop.py:213-296`).
+        `step` is the learner step this result belongs to — within a
+        fused group the trainer's counter is already at the group end,
+        so events must carry their own per-step x-value.
         """
         c = self.c
-        # BATCH_SIZE is the GLOBAL batch; in a multi-host run each host
-        # samples its share from its local buffer and shard_batch
-        # assembles the global array (trainer returns local TD rows).
-        local_batch = max(
-            1, self.cfg.BATCH_SIZE // jax.process_count()
-        )
-        with self.profile.phase("sample"):
-            sample = c.buffer.sample(
-                local_batch, current_train_step=self.global_step
-            )
-        if sample is None:
-            return False
-        with self.profile.phase("train"):
-            out = c.trainer.train_step(sample["batch"])
-        if out is None:
-            return False
-        metrics, td_errors = out
-        c.buffer.update_priorities(sample["indices"], td_errors)
-        self.global_step = c.trainer.global_step
+        c.buffer.update_priorities(indices, td_errors)
+        self.global_step = step
         self._steps_this_run += 1
-
-        step = self.global_step
         events = [
             RawMetricEvent(
                 name=f"Loss/{key}", value=val, global_step=step
@@ -229,19 +214,108 @@ class TrainingLoop:
             )
         c.stats.log_batch_events(events)
 
-        if step % self.cfg.WORKER_UPDATE_FREQ_STEPS == 0:
-            c.trainer.sync_to_network()
+    def _maybe_sync_weights(self, prev_step: int) -> None:
+        """Push learner params when (prev_step, global_step] crossed a
+        WORKER_UPDATE_FREQ_STEPS multiple (reference `loop.py:271-287`).
+        A fused group can cross at most once per call; one sync installs
+        the group-end params either way."""
+        freq = self.cfg.WORKER_UPDATE_FREQ_STEPS
+        if self._crossed(self.global_step, freq, prev_step):
+            self.c.trainer.sync_to_network()
             self.weight_updates += 1
-            c.stats.log_scalar(
-                "Progress/Weight_Updates_Total", self.weight_updates, step
+            self.c.stats.log_scalar(
+                "Progress/Weight_Updates_Total",
+                self.weight_updates,
+                self.global_step,
             )
-        return True
+
+    def _run_training_step(self) -> bool:
+        """One sample -> train -> priority-update -> maybe sync cycle.
+
+        Returns False when the buffer could not produce a batch
+        (reference `loop.py:213-296`).
+        """
+        return self._run_training_steps(1) == 1
+
+    def _run_training_steps(self, max_steps: int) -> int:
+        """Up to `max_steps` learner steps, dispatched in fused groups
+        of `FUSED_LEARNER_STEPS`. Returns the number of steps run.
+
+        Within a group, PER priorities update after the group's single
+        dispatch (staleness bounded by the group size); sampling,
+        checkpoint and weight-sync cadences run at group boundaries.
+        """
+        c = self.c
+        # BATCH_SIZE is the GLOBAL batch; in a multi-host run each host
+        # samples its share from its local buffer and shard_batch
+        # assembles the global array (trainer returns local TD rows).
+        local_batch = max(1, self.cfg.BATCH_SIZE // jax.process_count())
+        k = max(1, self.cfg.FUSED_LEARNER_STEPS)
+        ran = 0
+        while ran < max_steps and not self.stop_event.is_set():
+            budget = max_steps - ran
+            if self.cfg.MAX_TRAINING_STEPS is not None:
+                budget = min(
+                    budget, self.cfg.MAX_TRAINING_STEPS - self.global_step
+                )
+            if budget <= 0:
+                break
+            group = min(k, budget)
+            with self.profile.phase("sample"):
+                samples = []
+                for _ in range(group):
+                    s = c.buffer.sample(
+                        local_batch, current_train_step=self.global_step
+                    )
+                    if s is None:
+                        break
+                    samples.append(s)
+            if not samples:
+                break
+            prev_step = self.global_step
+            with self.profile.phase("train"):
+                if len(samples) == k and k > 1:
+                    outs = c.trainer.train_steps(
+                        [s["batch"] for s in samples]
+                    )
+                else:
+                    # Tail / short groups run as single steps: the
+                    # per-step program is already compiled, while a
+                    # fused program per distinct K would recompile.
+                    outs = []
+                    for s in samples:
+                        out = c.trainer.train_step(s["batch"])
+                        if out is None:
+                            break
+                        outs.append(out)
+            if not outs:
+                break
+            for i, (s, (metrics, td_errors)) in enumerate(
+                zip(samples, outs)
+            ):
+                self._record_step(
+                    metrics, td_errors, s["indices"], prev_step + i + 1
+                )
+            ran += len(outs)
+            self._maybe_sync_weights(prev_step)
+            with self.profile.phase("checkpoint"):
+                self._maybe_checkpoint()
+            if len(outs) < group:
+                break
+        return ran
+
+    def _crossed(self, step: int, freq: int, last: int | None) -> bool:
+        """Did `step` cross a `freq` multiple since `last`? (Cadence
+        check robust to steps advancing by more than 1 per call, as
+        fused learner groups do.)"""
+        anchor = last if last is not None else self._cadence_anchor
+        return step > 0 and step // freq > anchor // freq
 
     def _maybe_checkpoint(self, force: bool = False) -> None:
         c = self.c
         step = self.global_step
-        due = force or (
-            step > 0 and step % self.cfg.CHECKPOINT_SAVE_FREQ_STEPS == 0
+        due = force or self._crossed(
+            step, self.cfg.CHECKPOINT_SAVE_FREQ_STEPS, self._last_saved_step
         )
         if due and self._last_saved_step != step:
             self._last_saved_step = step
@@ -256,12 +330,17 @@ class TrainingLoop:
             )
         save_buffer = c.persistence_config.SAVE_BUFFER and (
             force
-            or (
-                step > 0
-                and step % c.persistence_config.BUFFER_SAVE_FREQ_STEPS == 0
+            or self._crossed(
+                step,
+                c.persistence_config.BUFFER_SAVE_FREQ_STEPS,
+                self._last_buffer_saved_step,
             )
         )
-        if save_buffer:
+        # On force, always spill: late harvests may have been folded
+        # into the buffer after a cadence save at this same step (the
+        # async shutdown path does exactly that).
+        if save_buffer and (force or self._last_buffer_saved_step != step):
+            self._last_buffer_saved_step = step
             c.checkpoints.save_buffer(step, c.buffer)
 
     def _log_progress(self) -> None:
@@ -338,16 +417,7 @@ class TrainingLoop:
             n_steps = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
                 1, round(added / cfg.BATCH_SIZE)
             )
-            for _ in range(n_steps):
-                if self._max_steps_reached():
-                    break
-                if not self._run_training_step():
-                    break
-                # Cadence check per learner step: iterations can run
-                # several steps, which would hop over multiples of
-                # CHECKPOINT_SAVE_FREQ_STEPS.
-                with self.profile.phase("checkpoint"):
-                    self._maybe_checkpoint()
+            self._run_training_steps(n_steps)
             self._iteration_tail()
 
     # --- overlapped producer/consumer ------------------------------------
@@ -437,15 +507,9 @@ class TrainingLoop:
                             folded += 1
                         except queue.Empty:
                             pass
-                steps_ran = 0
-                for _ in range(self._learner_steps_allowed()):
-                    if self._max_steps_reached() or self.stop_event.is_set():
-                        break
-                    if not self._run_training_step():
-                        break
-                    steps_ran += 1
-                    with self.profile.phase("checkpoint"):
-                        self._maybe_checkpoint()
+                steps_ran = self._run_training_steps(
+                    self._learner_steps_allowed()
+                )
                 if folded == 0 and steps_ran == 0:
                     # Gate open but the buffer can't produce a batch yet
                     # (or the trainer rejected one): don't busy-spin.

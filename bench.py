@@ -200,6 +200,24 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     learner_steps_per_sec = n_steps / (time.time() - t0)
     log(f"bench: learner {learner_steps_per_sec:.2f} steps/s (batch {b})")
 
+    # Fused groups: K steps per dispatch (one round trip per group) —
+    # the FUSED_LEARNER_STEPS path the loop uses on tunneled chips.
+    # CPU unrolls the group (see Trainer._train_steps_impl), so keep K
+    # small there to bound compile time.
+    fused_k = 4 if (smoke or backend == "cpu") else 16
+    fused_batches = [batch] * fused_k
+    trainer.train_steps(fused_batches)  # compile
+    n_groups = 2 if smoke else 5
+    t0 = time.time()
+    for _ in range(n_groups):
+        trainer.train_steps(fused_batches)
+    jax.block_until_ready(trainer.state.params)
+    fused_steps_per_sec = n_groups * fused_k / (time.time() - t0)
+    log(
+        f"bench: fused learner {fused_steps_per_sec:.2f} steps/s "
+        f"(batch {b}, K={fused_k})"
+    )
+
     # --- overlapped producer/consumer (combined rates) ------------------
     # The phases above run each side alone; this measures both at once
     # (the training loop's ASYNC_ROLLOUTS topology): a producer thread
@@ -224,8 +242,8 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     t0 = time.time()
     o_steps = 0
     while time.time() - t0 < overlap_seconds:
-        trainer.train_step(batch)
-        o_steps += 1
+        trainer.train_steps(fused_batches)
+        o_steps += fused_k
     jax.block_until_ready(trainer.state.params)
     stop.set()
     th.join(timeout=120)
@@ -267,6 +285,8 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "moves_per_sec": round(moves_per_sec, 1),
             "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
             "learner_steps_per_sec": round(learner_steps_per_sec, 2),
+            "learner_steps_per_sec_fused": round(fused_steps_per_sec, 2),
+            "fused_group_size": fused_k,
             "learner_batch": b,
             "first_chunk_compile_seconds": round(compile_s, 1),
             "overlapped": overlapped,

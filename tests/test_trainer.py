@@ -163,6 +163,56 @@ class TestTrainStep:
         )
 
 
+class TestFusedSteps:
+    """`train_steps` (FUSED_LEARNER_STEPS) must be a pure dispatch
+    optimization: K fused steps == K sequential steps."""
+
+    def test_fused_matches_sequential(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        batches = [make_batch(seed=i) for i in range(3)]
+        net_a = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        net_b = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        tr_seq = Trainer(net_a, tiny_train_config)
+        tr_fused = Trainer(net_b, tiny_train_config)
+
+        seq = [tr_seq.train_step(b) for b in batches]
+        fused = tr_fused.train_steps(batches)
+
+        assert len(fused) == 3
+        assert tr_fused.global_step == 3
+        for (m_s, td_s), (m_f, td_f) in zip(seq, fused):
+            np.testing.assert_allclose(td_s, td_f, rtol=1e-5, atol=1e-6)
+            for key in m_s:
+                assert m_s[key] == pytest.approx(
+                    m_f[key], rel=1e-4, abs=1e-6
+                ), key
+        p_seq = jax.tree_util.tree_leaves(tr_seq.state.params)
+        p_fused = jax.tree_util.tree_leaves(tr_fused.state.params)
+        for a, b in zip(p_seq, p_fused):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_single_batch_delegates(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        out = trainer.train_steps([make_batch()])
+        assert len(out) == 1
+        assert trainer.global_step == 1
+
+    def test_empty_list(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        assert trainer.train_steps([]) == []
+        assert trainer.global_step == 0
+
+    def test_host_step_mirrors_device_step(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        trainer.train_step(make_batch())
+        trainer.train_steps([make_batch(seed=1), make_batch(seed=2)])
+        assert trainer.global_step == 3
+        assert int(trainer.state.step) == 3
+
+
 class TestBatchNormPath:
     def test_batch_stats_updated(self, tiny_model_config, tiny_env_config):
         bn_cfg = tiny_model_config.model_copy(update={"NORM_TYPE": "batch"})

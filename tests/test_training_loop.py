@@ -92,6 +92,35 @@ class TestLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    def test_fused_learner_steps_run(self, tmp_path, tiny_world_configs):
+        """FUSED_LEARNER_STEPS>1 completes the same run; cadences use
+        crossing checks because steps advance by the group size."""
+        c = build(
+            tmp_path, tiny_world_configs, run_name="fused_run",
+            FUSED_LEARNER_STEPS=3,
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 8
+        # Weight sync: one sync per group that crosses a freq-2
+        # multiple (group boundaries depend on harvest sizes, so the
+        # count is bounded, not exact: 8 steps in groups of <=3 means
+        # at least ceil(8/3)=3 boundary checks, at most the per-step 4).
+        assert 2 <= loop.weight_updates <= 4
+        assert c.net.weights_version == loop.weight_updates
+        # Checkpoint crossing (freq 4) + final save at 8.
+        steps = sorted(
+            int(p.name.split("_")[1])
+            for p in c.persistence_config.get_checkpoint_dir().iterdir()
+            if p.is_dir()
+        )
+        assert steps[-1] == 8
+        assert any(4 <= s <= 8 for s in steps)
+        assert c.stats.latest("Loss/total_loss") is not None
+        c.stats.close()
+        c.checkpoints.close()
+
     def test_stop_event(self, tmp_path, tiny_world_configs):
         c = build(
             tmp_path, tiny_world_configs, run_name="stop_run",
