@@ -11,6 +11,10 @@ Axes:
 - "mdl": model parallel (tensor sharding of wide layers; size 1 by
   default — the flagship net is ~3M params — but the sharding rules are
   written against this axis so scaling it up requires no code change).
+- "sp": sequence/context parallel (ring or all-to-all attention over
+  sequence shards, `parallel/ring_attention.py`; size 1 by default —
+  the flagship spatial sequence is 120 tokens — but long-context runs
+  shard attention over this axis with no model-code change).
 """
 
 import math
@@ -28,22 +32,26 @@ class MeshConfig(BaseModel):
     # -1 means "all remaining devices" on the dp axis.
     DP_SIZE: int = Field(default=-1)
     MDL_SIZE: int = Field(default=1, ge=1)
+    SP_SIZE: int = Field(default=1, ge=1)
     DP_AXIS: str = Field(default="dp")
     MDL_AXIS: str = Field(default="mdl")
+    SP_AXIS: str = Field(default="sp")
     # Which JAX platform to build the mesh on ("auto" = default backend).
     PLATFORM: Literal["auto", "tpu", "cpu"] = Field(default="auto")
 
     def resolve_dp_size(self, n_devices: int) -> int:
+        other = self.MDL_SIZE * self.SP_SIZE
         if self.DP_SIZE == -1:
-            if n_devices % self.MDL_SIZE != 0:
+            if n_devices % other != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by MDL_SIZE={self.MDL_SIZE}"
+                    f"{n_devices} devices not divisible by "
+                    f"MDL_SIZE*SP_SIZE={other}"
                 )
-            return n_devices // self.MDL_SIZE
+            return n_devices // other
         return self.DP_SIZE
 
     def build_mesh(self, devices: list | None = None) -> Mesh:
-        """Construct the (dp, mdl) mesh over the available devices."""
+        """Construct the (dp, mdl, sp) mesh over the available devices."""
         if devices is None:
             devices = (
                 jax.devices()
@@ -51,20 +59,22 @@ class MeshConfig(BaseModel):
                 else jax.devices(self.PLATFORM)
             )
         dp = self.resolve_dp_size(len(devices))
-        needed = dp * self.MDL_SIZE
+        needed = dp * self.MDL_SIZE * self.SP_SIZE
         if needed > len(devices):
             raise ValueError(
-                f"Mesh needs {needed} devices (dp={dp} x mdl={self.MDL_SIZE}), "
-                f"only {len(devices)} available."
+                f"Mesh needs {needed} devices (dp={dp} x mdl={self.MDL_SIZE}"
+                f" x sp={self.SP_SIZE}), only {len(devices)} available."
             )
-        grid = np.asarray(devices[:needed]).reshape(dp, self.MDL_SIZE)
-        return Mesh(grid, (self.DP_AXIS, self.MDL_AXIS))
+        grid = np.asarray(devices[:needed]).reshape(
+            dp, self.MDL_SIZE, self.SP_SIZE
+        )
+        return Mesh(grid, (self.DP_AXIS, self.MDL_AXIS, self.SP_AXIS))
 
     @staticmethod
     def single_device_mesh() -> Mesh:
-        """A 1x1 mesh on the default device (works everywhere)."""
-        dev = np.asarray(jax.devices()[:1]).reshape(1, 1)
-        return Mesh(dev, ("dp", "mdl"))
+        """A 1x1x1 mesh on the default device (works everywhere)."""
+        dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1)
+        return Mesh(dev, ("dp", "mdl", "sp"))
 
 
 def largest_pow2_leq(n: int) -> int:

@@ -123,7 +123,13 @@ class ResidualBlock(nn.Module):
 
 
 class TransformerEncoderLayer(nn.Module):
-    """Pre-norm encoder layer (reference model.py:179-202, norm_first=True)."""
+    """Pre-norm encoder layer (reference model.py:179-202, norm_first=True).
+
+    `attention_fn` swaps the dense attention kernel for a
+    sequence-parallel one (`parallel/ring_attention.make_sp_attention`);
+    attention-weight dropout is disabled in that case (blockwise
+    kernels don't support it) — the residual dropouts still apply.
+    """
 
     dim: int
     heads: int
@@ -131,6 +137,7 @@ class TransformerEncoderLayer(nn.Module):
     act: Callable[[Array], Array]
     dtype: jnp.dtype
     dropout_rate: float = 0.1
+    attention_fn: Callable | None = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -138,8 +145,11 @@ class TransformerEncoderLayer(nn.Module):
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.heads,
             dtype=self.dtype,
-            dropout_rate=self.dropout_rate,
+            dropout_rate=(
+                0.0 if self.attention_fn is not None else self.dropout_rate
+            ),
             deterministic=not train,
+            attention_fn=self.attention_fn or nn.dot_product_attention,
         )(y, y)
         x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -170,10 +180,16 @@ class MLPHead(nn.Module):
 
 
 class AlphaTriangleNet(nn.Module):
-    """Policy + C51 value network over (grid, other_features)."""
+    """Policy + C51 value network over (grid, other_features).
+
+    `attention_fn`: optional sequence-parallel attention kernel for the
+    transformer stack (see `parallel/ring_attention.make_sp_attention`);
+    None = dense single-device attention.
+    """
 
     config: ModelConfig
     action_dim: int
+    attention_fn: Callable | None = None
 
     @nn.compact
     def __call__(
@@ -224,6 +240,7 @@ class AlphaTriangleNet(nn.Module):
                     cfg.TRANSFORMER_FC_DIM,
                     act,
                     dtype,
+                    attention_fn=self.attention_fn,
                 )(tokens, train)
             tokens = nn.LayerNorm(dtype=dtype)(tokens)
             flat = tokens.reshape(b, -1)

@@ -45,11 +45,19 @@ class NeuralNetwork:
         env_config: EnvConfig,
         seed: int = 0,
         variables: dict | None = None,
+        attention_fn=None,
     ):
+        """`attention_fn`: optional sequence-parallel attention kernel
+        (`parallel/ring_attention.make_sp_attention`) threaded into the
+        model's transformer; params are identical either way, so a net
+        can be built dense and evaluated sequence-sharded or vice versa.
+        """
         self.model_config = model_config
         self.env_config = env_config
         self.action_dim = env_config.action_dim
-        self.model = AlphaTriangleNet(model_config, self.action_dim)
+        self.model = AlphaTriangleNet(
+            model_config, self.action_dim, attention_fn=attention_fn
+        )
 
         self.num_atoms = model_config.NUM_VALUE_ATOMS
         self.v_min = model_config.VALUE_MIN

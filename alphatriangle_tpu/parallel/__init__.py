@@ -11,6 +11,11 @@ from .distributed import (
     is_primary,
     process_info,
 )
+from .ring_attention import (
+    make_sp_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .sharding import (
     batch_sharding,
     replicated,
@@ -23,8 +28,11 @@ __all__ = [
     "batch_sharding",
     "initialize_distributed",
     "is_primary",
+    "make_sp_attention",
     "process_info",
     "replicated",
+    "ring_attention",
     "shard_batch",
     "state_shardings",
+    "ulysses_attention",
 ]

@@ -72,6 +72,14 @@ class TrainingLoop:
             enabled=self.cfg.PROFILE_WORKERS,
             profile_dir=components.persistence_config.get_profile_dir(),
         )
+        if self.cfg.FUSED_LEARNER_STEPS > self.cfg.WORKER_UPDATE_FREQ_STEPS:
+            logger.warning(
+                "FUSED_LEARNER_STEPS=%d > WORKER_UPDATE_FREQ_STEPS=%d: "
+                "weights can only sync at group boundaries, so the "
+                "effective sync cadence is the group size.",
+                self.cfg.FUSED_LEARNER_STEPS,
+                self.cfg.WORKER_UPDATE_FREQ_STEPS,
+            )
 
     # --- resume -----------------------------------------------------------
 
@@ -217,8 +225,11 @@ class TrainingLoop:
     def _maybe_sync_weights(self, prev_step: int) -> None:
         """Push learner params when (prev_step, global_step] crossed a
         WORKER_UPDATE_FREQ_STEPS multiple (reference `loop.py:271-287`).
-        A fused group can cross at most once per call; one sync installs
-        the group-end params either way."""
+
+        One sync per call regardless of how many multiples the group
+        crossed — only the group-end params exist to install, so with
+        FUSED_LEARNER_STEPS > WORKER_UPDATE_FREQ_STEPS the effective
+        sync cadence is the group size (warned at loop start)."""
         freq = self.cfg.WORKER_UPDATE_FREQ_STEPS
         if self._crossed(self.global_step, freq, prev_step):
             self.c.trainer.sync_to_network()

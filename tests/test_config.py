@@ -80,7 +80,12 @@ def test_mesh_config_builds_8_device_cpu_mesh():
     import jax
 
     mesh = MeshConfig(DP_SIZE=-1, MDL_SIZE=2).build_mesh(jax.devices("cpu"))
-    assert mesh.shape == {"dp": 4, "mdl": 2}
+    assert mesh.shape == {"dp": 4, "mdl": 2, "sp": 1}
+
+    sp_mesh = MeshConfig(DP_SIZE=2, MDL_SIZE=2, SP_SIZE=2).build_mesh(
+        jax.devices("cpu")
+    )
+    assert sp_mesh.shape == {"dp": 2, "mdl": 2, "sp": 2}
 
 
 def test_mesh_config_rejects_indivisible():
