@@ -36,6 +36,8 @@ class MeshConfig(BaseModel):
     DP_AXIS: str = Field(default="dp")
     MDL_AXIS: str = Field(default="mdl")
     SP_AXIS: str = Field(default="sp")
+    # Attention kind used when SP_SIZE > 1 (parallel/ring_attention.py).
+    SP_ATTENTION: Literal["ring", "ulysses"] = Field(default="ring")
     # Which JAX platform to build the mesh on ("auto" = default backend).
     PLATFORM: Literal["auto", "tpu", "cpu"] = Field(default="auto")
 

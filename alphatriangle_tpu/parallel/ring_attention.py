@@ -189,7 +189,21 @@ def make_sp_attention(
     )
     dp_total = mesh.shape[dp_axis] if dp_axis is not None else 1
 
-    def attention_fn(query, key, value, bias=None, mask=None, **kwargs):
+    # dropout_rate/deterministic MUST be named parameters, not **kwargs:
+    # flax's MultiHeadDotProductAttention filters the kwargs it forwards
+    # to an attention_fn by inspecting its signature, so a **kwargs
+    # catch-all would never receive them and the guard below would be
+    # dead code on the real integration path.
+    def attention_fn(
+        query,
+        key,
+        value,
+        bias=None,
+        mask=None,
+        dropout_rate=0.0,
+        deterministic=True,
+        **kwargs,
+    ):
         if bias is not None or mask is not None:
             raise NotImplementedError(
                 "sequence-parallel attention does not support bias/mask"
@@ -199,9 +213,7 @@ def make_sp_attention(
                 f"ulysses attention needs head count ({query.shape[2]}) "
                 f"divisible by the sp axis size ({n}); use kind='ring'"
             )
-        if kwargs.get("dropout_rate", 0.0) and not kwargs.get(
-            "deterministic", True
-        ):
+        if dropout_rate and not deterministic:
             raise NotImplementedError(
                 "sequence-parallel attention does not support attention-"
                 "weight dropout; set ATTENTION_DROPOUT=0 or eval mode"

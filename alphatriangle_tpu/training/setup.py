@@ -68,8 +68,29 @@ def setup_training_components(
 
     env = TriangleEnv(env_config)
     extractor = get_feature_extractor(env, model_config)
+    # Sequence-parallel attention when the mesh has a real sp axis
+    # (otherwise a configured SP_SIZE would silently shard nothing and
+    # halve effective throughput with replicated work).
+    attention_fn = None
+    if mesh.shape.get(mesh_config.SP_AXIS, 1) > 1:
+        from ..parallel import make_sp_attention
+
+        attention_fn = make_sp_attention(
+            mesh,
+            kind=mesh_config.SP_ATTENTION,
+            sp_axis=mesh_config.SP_AXIS,
+            dp_axis=mesh_config.DP_AXIS,
+        )
+        logger.info(
+            "Sequence-parallel attention: %s over sp=%d",
+            mesh_config.SP_ATTENTION,
+            mesh.shape[mesh_config.SP_AXIS],
+        )
     net = NeuralNetwork(
-        model_config, env_config, seed=train_config.RANDOM_SEED
+        model_config,
+        env_config,
+        seed=train_config.RANDOM_SEED,
+        attention_fn=attention_fn,
     )
     trainer = Trainer(net, train_config, mesh=mesh)
     buffer = ExperienceBuffer(train_config, action_dim=env_config.action_dim)

@@ -121,6 +121,33 @@ class TestLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    def test_sp_mesh_end_to_end(self, tmp_path, tiny_world_configs):
+        """setup wires ring attention automatically when the mesh has a
+        real sp axis; the whole loop (self-play search included) runs
+        sequence-sharded on (dp=4, sp=2)."""
+        from alphatriangle_tpu.config import MeshConfig
+
+        env_cfg, model_cfg, mcts_cfg = tiny_world_configs
+        tc = make_train_cfg("sp_run", str(tmp_path), MAX_TRAINING_STEPS=2)
+        pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="sp_run")
+        c = setup_training_components(
+            train_config=tc,
+            env_config=env_cfg,
+            model_config=model_cfg,
+            mcts_config=mcts_cfg,
+            mesh_config=MeshConfig(DP_SIZE=4, SP_SIZE=2),
+            persistence_config=pc,
+            use_tensorboard=False,
+        )
+        assert c.net.model.attention_fn is not None
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 2
+        assert loop.episodes_played >= 0
+        c.stats.close()
+        c.checkpoints.close()
+
     def test_stop_event(self, tmp_path, tiny_world_configs):
         c = build(
             tmp_path, tiny_world_configs, run_name="stop_run",
