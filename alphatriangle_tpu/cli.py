@@ -35,6 +35,15 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         help="Capture a jax.profiler trace + per-phase timers into "
         "runs/<run>/profile_data/.",
     )
+    p.add_argument(
+        "--preset",
+        type=int,
+        default=None,
+        metavar="N",
+        choices=[1, 2, 3, 4, 5],
+        help="BASELINE benchmark config 1..5 (config/presets.py); "
+        "explicit flags below override preset values.",
+    )
     # TPU-native sizing knobs.
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--self-play-batch", type=int, default=None)
@@ -91,6 +100,24 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--process-id", type=int, default=None)
 
 
+def merge_train_overrides(base_config, overrides: dict):
+    """Apply CLI overrides on top of a preset TrainConfig.
+
+    Rebuilds through the constructor (NOT model_copy) so pydantic
+    validation runs, and drops derived schedule lengths when the
+    horizon changes so they re-derive instead of keeping the preset's
+    values (TrainConfig._derive_schedule_lengths only fills Nones).
+    """
+    from .config import TrainConfig
+
+    base = base_config.model_dump()
+    if "MAX_TRAINING_STEPS" in overrides:
+        base.pop("LR_SCHEDULER_T_MAX", None)
+        base.pop("PER_BETA_ANNEAL_STEPS", None)
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     from .config import PersistenceConfig, TrainConfig
     from .parallel.distributed import DistributedConfig
@@ -131,7 +158,19 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["PROFILE_WORKERS"] = True
     if args.device is not None:
         overrides["DEVICE"] = args.device
-    train_config = TrainConfig(**overrides)
+
+    env_config = model_config = mcts_config = mesh_config = None
+    if args.preset is not None:
+        from .config import baseline_preset
+
+        bundle = baseline_preset(args.preset, run_name=args.run_name)
+        env_config = bundle["env"]
+        model_config = bundle["model"]
+        mcts_config = bundle["mcts"]
+        mesh_config = bundle["mesh"]
+        train_config = merge_train_overrides(bundle["train"], overrides)
+    else:
+        train_config = TrainConfig(**overrides)
 
     persistence_config = None
     if args.root_dir is not None:
@@ -148,6 +187,10 @@ def cmd_train(args: argparse.Namespace) -> int:
         )
     return run_training(
         train_config=train_config,
+        env_config=env_config,
+        model_config=model_config,
+        mcts_config=mcts_config,
+        mesh_config=mesh_config,
         persistence_config=persistence_config,
         distributed_config=distributed_config,
         log_level=args.log_level,

@@ -6,6 +6,10 @@ from alphatriangle_tpu.config.mcts_config import AlphaTriangleMCTSConfig, MCTSCo
 from alphatriangle_tpu.config.mesh_config import MeshConfig
 from alphatriangle_tpu.config.model_config import ModelConfig
 from alphatriangle_tpu.config.persistence_config import PersistenceConfig
+from alphatriangle_tpu.config.presets import (
+    PRESET_DESCRIPTIONS,
+    baseline_preset,
+)
 from alphatriangle_tpu.config.train_config import TrainConfig
 from alphatriangle_tpu.config.validation import (
     expected_other_features_dim,
@@ -19,8 +23,10 @@ __all__ = [
     "MCTSConfig",
     "MeshConfig",
     "ModelConfig",
+    "PRESET_DESCRIPTIONS",
     "PersistenceConfig",
     "TrainConfig",
+    "baseline_preset",
     "expected_other_features_dim",
     "print_config_info_and_validate",
 ]

@@ -111,37 +111,88 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         f"{backend} device={getattr(device, 'device_kind', device)}"
     )
 
-    # Three scales: smoke (sanity), cpu (a CPU can't push the flagship
-    # load — one flagship chunk is ~30 min of CPU leaf evals — so the
-    # fallback measures a reduced but honest config), flagship (TPU).
-    if smoke:
-        scale, sims, depth, sp_batch, chunk, lbatch = "smoke", 8, 4, 16, 4, 32
-    elif backend == "cpu":
-        scale, sims, depth, sp_batch, chunk, lbatch = "cpu", 16, 8, 64, 4, 128
-    else:
-        scale, sims, depth, sp_batch, chunk, lbatch = "flagship", 64, 8, 512, 16, 256
-    log(f"bench: scale={scale} sims={sims} batch={sp_batch} chunk={chunk}")
+    preset = os.environ.get("BENCH_CONFIG")
+    if preset:
+        # One of the five BASELINE configs (config/presets.py).
+        from alphatriangle_tpu.config import baseline_preset
 
-    env_cfg = EnvConfig()
-    model_cfg = ModelConfig(
-        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
-        COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
-    )
-    mcts_cfg = AlphaTriangleMCTSConfig(
-        max_simulations=sims,
-        max_depth=depth,
-        # A/B knob for the descent row-gather lowering (ops/gather_rows.py).
-        descent_gather=os.environ.get("BENCH_GATHER", "einsum"),
-    )
-    train_cfg = TrainConfig(
-        SELF_PLAY_BATCH_SIZE=sp_batch,
-        ROLLOUT_CHUNK_MOVES=chunk,
-        BATCH_SIZE=lbatch,
-        BUFFER_CAPACITY=10_000,
-        MIN_BUFFER_SIZE_TO_TRAIN=1_000,
-        MAX_TRAINING_STEPS=1_000,
-        RUN_NAME="bench",
-    )
+        from alphatriangle_tpu.config import TrainConfig as _TrainConfig
+
+        bundle = baseline_preset(int(preset), run_name="bench")
+        env_cfg, model_cfg = bundle["env"], bundle["model"]
+        mcts_cfg = bundle["mcts"].model_copy(
+            # Honor the A/B lowering knob here too.
+            update={"descent_gather": os.environ.get("BENCH_GATHER", "einsum")}
+        )
+        train_updates = {
+            "BUFFER_CAPACITY": 10_000,
+            "MIN_BUFFER_SIZE_TO_TRAIN": 1_000,
+            "MAX_TRAINING_STEPS": 1_000,
+        }
+        if backend == "cpu" or smoke:
+            # Neither a CPU nor a smoke run can push the preset's full
+            # lane count; keep the net/search knobs, shrink lanes.
+            cap = 16 if smoke else 64
+            train_updates["SELF_PLAY_BATCH_SIZE"] = min(
+                cap, bundle["train"].SELF_PLAY_BATCH_SIZE
+            )
+            train_updates["ROLLOUT_CHUNK_MOVES"] = 4
+        if backend == "cpu":
+            model_cfg = model_cfg.model_copy(
+                update={"COMPUTE_DTYPE": "float32"}
+            )
+        # Rebuild via the constructor so validation + schedule-length
+        # derivation run against the bench horizon.
+        base_kw = bundle["train"].model_dump()
+        base_kw.pop("LR_SCHEDULER_T_MAX", None)
+        base_kw.pop("PER_BETA_ANNEAL_STEPS", None)
+        base_kw.update(train_updates)
+        train_cfg = _TrainConfig(**base_kw)
+        scale = f"baseline_config_{preset}"
+        sims = mcts_cfg.max_simulations
+        sp_batch = train_cfg.SELF_PLAY_BATCH_SIZE
+        chunk = train_cfg.ROLLOUT_CHUNK_MOVES
+        lbatch = train_cfg.BATCH_SIZE
+        log(f"bench: {scale}: {bundle['description']}")
+    else:
+        # Three scales: smoke (sanity), cpu (a CPU can't push the
+        # flagship load — one flagship chunk is ~30 min of CPU leaf
+        # evals — so the fallback measures a reduced but honest
+        # config), flagship (TPU).
+        if smoke:
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "smoke", 8, 4, 16, 4, 32,
+            )
+        elif backend == "cpu":
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "cpu", 16, 8, 64, 4, 128,
+            )
+        else:
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "flagship", 64, 8, 512, 16, 256,
+            )
+        env_cfg = EnvConfig()
+        model_cfg = ModelConfig(
+            OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+            COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+        )
+        mcts_cfg = AlphaTriangleMCTSConfig(
+            max_simulations=sims,
+            max_depth=depth,
+            # A/B knob for the descent row-gather lowering
+            # (ops/gather_rows.py).
+            descent_gather=os.environ.get("BENCH_GATHER", "einsum"),
+        )
+        train_cfg = TrainConfig(
+            SELF_PLAY_BATCH_SIZE=sp_batch,
+            ROLLOUT_CHUNK_MOVES=chunk,
+            BATCH_SIZE=lbatch,
+            BUFFER_CAPACITY=10_000,
+            MIN_BUFFER_SIZE_TO_TRAIN=1_000,
+            MAX_TRAINING_STEPS=1_000,
+            RUN_NAME="bench",
+        )
+    log(f"bench: scale={scale} sims={sims} batch={sp_batch} chunk={chunk}")
 
     env = TriangleEnv(env_cfg)
     extractor = get_feature_extractor(env, model_cfg)

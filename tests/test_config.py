@@ -88,6 +88,45 @@ def test_mesh_config_builds_8_device_cpu_mesh():
     assert sp_mesh.shape == {"dp": 2, "mdl": 2, "sp": 2}
 
 
+def test_baseline_presets_valid():
+    """All five BASELINE presets produce mutually-consistent configs
+    (feature dims match the env, transformer dims divide heads, etc.)."""
+    from alphatriangle_tpu.config import baseline_preset
+    from alphatriangle_tpu.config.validation import (
+        expected_other_features_dim,
+    )
+
+    for n in range(1, 6):
+        b = baseline_preset(n)
+        assert b["model"].OTHER_NN_INPUT_FEATURES_DIM == (
+            expected_other_features_dim(b["env"])
+        )
+        assert b["train"].SELF_PLAY_BATCH_SIZE >= 16
+    assert baseline_preset(1)["model"].USE_TRANSFORMER is False
+    assert baseline_preset(3)["model"].TRANSFORMER_LAYERS == 4
+    assert baseline_preset(4)["mcts"].max_simulations == 400
+    p5 = baseline_preset(5)
+    assert p5["env"].ROWS == 12 and p5["model"].TRANSFORMER_LAYERS == 8
+    with pytest.raises(ValueError):
+        baseline_preset(6)
+
+
+def test_preset_overrides_revalidate_and_rederive():
+    """CLI overrides on a preset must go through the constructor:
+    schedule lengths re-derive from a new horizon and invalid combos
+    raise instead of being silently accepted."""
+    from alphatriangle_tpu.cli import merge_train_overrides
+    from alphatriangle_tpu.config import baseline_preset
+
+    base = baseline_preset(3)["train"]
+    merged = merge_train_overrides(base, {"MAX_TRAINING_STEPS": 5000})
+    assert merged.LR_SCHEDULER_T_MAX == 5000
+    assert merged.PER_BETA_ANNEAL_STEPS == 5000
+    with pytest.raises(ValueError):
+        merge_train_overrides(base, {"BUFFER_CAPACITY": 100})
+    assert baseline_preset(1)["train"].DEVICE == "cpu"
+
+
 def test_mesh_config_rejects_indivisible():
     import jax
 
