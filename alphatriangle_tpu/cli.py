@@ -71,6 +71,21 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         default=None,
         help="Async mode: samples consumed per experience produced.",
     )
+    p.add_argument(
+        "--fast-sims",
+        type=int,
+        default=None,
+        metavar="S",
+        help="Enable playout cap randomization: fast searches use S "
+        "sims; only full searches train the policy.",
+    )
+    p.add_argument(
+        "--full-search-prob",
+        type=float,
+        default=None,
+        help="Probability a move runs the full search under playout "
+        "cap randomization (default 0.25).",
+    )
     p.add_argument("--no-per", action="store_true")
     p.add_argument(
         "--no-auto-resume",
@@ -171,6 +186,21 @@ def cmd_train(args: argparse.Namespace) -> int:
         train_config = merge_train_overrides(bundle["train"], overrides)
     else:
         train_config = TrainConfig(**overrides)
+
+    if args.fast_sims is not None or args.full_search_prob is not None:
+        from .config import AlphaTriangleMCTSConfig
+
+        mcts_kw = mcts_config.model_dump() if mcts_config else {}
+        if args.fast_sims is not None:
+            mcts_kw["fast_simulations"] = args.fast_sims
+        if args.full_search_prob is not None:
+            mcts_kw["full_search_prob"] = args.full_search_prob
+        if mcts_kw.get("fast_simulations") is None:
+            raise SystemExit(
+                "--full-search-prob has no effect without --fast-sims "
+                "(playout cap randomization stays disabled)."
+            )
+        mcts_config = AlphaTriangleMCTSConfig(**mcts_kw)
 
     persistence_config = None
     if args.root_dir is not None:

@@ -41,6 +41,29 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # or "take" (XLA native gather). Numerically identical; a pure
     # performance knob to be settled by on-hardware benchmarks.
     descent_gather: str = Field(default="einsum", pattern="^(einsum|pallas|take)$")
+    # --- Playout cap randomization (KataGo, arXiv:1902.10565 §3.1;
+    # PAPERS.md) — beyond-reference acceleration, off by default. When
+    # `fast_simulations` is set, each lockstep move runs the full
+    # `max_simulations` search with probability `full_search_prob` and
+    # a cheap noiseless `fast_simulations` search otherwise. Only
+    # full-search moves produce policy-training targets (their
+    # experiences carry policy weight 1, fast moves 0); value targets
+    # come from every move. Self-play cost per move drops toward the
+    # fast budget while policy targets keep full-search quality.
+    fast_simulations: int | None = Field(default=None, gt=0)
+    full_search_prob: float = Field(default=0.25, gt=0, le=1.0)
+
+    @model_validator(mode="after")
+    def _check_fast(self) -> "AlphaTriangleMCTSConfig":
+        if (
+            self.fast_simulations is not None
+            and self.fast_simulations >= self.max_simulations
+        ):
+            raise ValueError(
+                "fast_simulations must be < max_simulations "
+                f"({self.fast_simulations} >= {self.max_simulations})"
+            )
+        return self
 
     @model_validator(mode="after")
     def _warn_depth(self) -> "AlphaTriangleMCTSConfig":

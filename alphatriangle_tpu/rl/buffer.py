@@ -85,6 +85,8 @@ class ExperienceBuffer:
                 (self.capacity, *policy.shape[1:]), dtype=np.float32
             ),
             "value_target": np.zeros(self.capacity, dtype=np.float32),
+            # Per-row policy-loss mask (0 for fast playout-cap moves).
+            "policy_weight": np.ones(self.capacity, dtype=np.float32),
         }
 
     # --- writes -----------------------------------------------------------
@@ -95,17 +97,24 @@ class ExperienceBuffer:
         other_features: np.ndarray,
         policy_target: np.ndarray,
         value_target: np.ndarray,
+        policy_weight: np.ndarray | None = None,
     ) -> np.ndarray:
         """Ring-insert a batch of experiences from dense arrays.
 
         Returns the slot indices used. New items get max-priority init
-        under PER (`buffer.py:55-70` semantics).
+        under PER (`buffer.py:55-70` semantics). `policy_weight` rows
+        mask the policy loss per sample (None -> ones).
         """
         grid = np.asarray(grid)
         other_features = np.asarray(other_features, dtype=np.float32)
         policy_target = np.asarray(policy_target, dtype=np.float32)
         value_target = np.asarray(value_target, dtype=np.float32).reshape(-1)
         k = grid.shape[0]
+        policy_weight = (
+            np.ones(k, dtype=np.float32)
+            if policy_weight is None
+            else np.asarray(policy_weight, dtype=np.float32).reshape(-1)
+        )
         if k == 0:
             return np.zeros(0, dtype=np.int64)
         finite = (
@@ -121,6 +130,7 @@ class ExperienceBuffer:
             other_features = other_features[finite]
             policy_target = policy_target[finite]
             value_target = value_target[finite]
+            policy_weight = policy_weight[finite]
             k = grid.shape[0]
             if k == 0:
                 return np.zeros(0, dtype=np.int64)
@@ -131,6 +141,7 @@ class ExperienceBuffer:
         self._storage["other_features"][idxs] = other_features
         self._storage["policy_target"][idxs] = policy_target
         self._storage["value_target"][idxs] = value_target
+        self._storage["policy_weight"][idxs] = policy_weight
         if self.tree is not None:
             self.tree.update_batch(
                 idxs, np.full(k, self.tree.max_priority, dtype=np.float64)
@@ -214,6 +225,7 @@ class ExperienceBuffer:
             "policy_target": self._storage["policy_target"][slots],
             "value_target": self._storage["value_target"][slots],
             "weights": weights,
+            "policy_weight": self._storage["policy_weight"][slots],
         }
         return {"batch": batch, "indices": slots.astype(np.int64), "weights": weights}
 
@@ -279,8 +291,19 @@ class ExperienceBuffer:
             storage["policy_target"][:1],
         )
         assert self._storage is not None
+        # Columns added after a snapshot was written restore to an
+        # explicit default; anything else missing is loud corruption.
+        restore_defaults = {"policy_weight": 1.0}  # pre-PCR: trainable
         for k in self._storage:
-            self._storage[k][:n] = storage[k][order]
+            if k in storage:
+                self._storage[k][:n] = storage[k][order]
+            elif k in restore_defaults:
+                self._storage[k][:n] = restore_defaults[k]
+            else:
+                raise KeyError(
+                    f"Buffer snapshot is missing column {k!r} and no "
+                    "restore default is defined for it."
+                )
         self._size = n
         self._pos = n % self.capacity
         if self.tree is not None:

@@ -65,6 +65,7 @@ class RolloutCarry:
     pend_grid: jax.Array  # (B, n, C, H, W) float32 pending features
     pend_other: jax.Array  # (B, n, F) float32
     pend_policy: jax.Array  # (B, n, A) float32 pending policy targets
+    pend_pweight: jax.Array  # (B, n) float32 policy-loss weight (PCR)
     pend_return: jax.Array  # (B, n) float32 discounted partial returns
     pend_discount: jax.Array  # (B, n) float32 next-reward discounts
     pend_active: jax.Array  # (B, n) bool slot occupancy
@@ -91,6 +92,20 @@ class SelfPlayEngine:
         self.mcts = BatchedMCTS(
             env, extractor, net.model, mcts_config, net.support
         )
+        # Playout cap randomization (KataGo, arXiv:1902.10565 §3.1):
+        # a second, cheap search program for the non-policy-training
+        # moves — fewer sims, no root noise (exploit, don't explore).
+        self.mcts_fast: BatchedMCTS | None = None
+        if mcts_config.fast_simulations is not None:
+            fast_cfg = mcts_config.model_copy(
+                update={
+                    "max_simulations": mcts_config.fast_simulations,
+                    "dirichlet_epsilon": 0.0,
+                }
+            )
+            self.mcts_fast = BatchedMCTS(
+                env, extractor, net.model, fast_cfg, net.support
+            )
         self.config = train_config
         self.mcts_config = mcts_config
         self.batch_size = batch_size or train_config.SELF_PLAY_BATCH_SIZE
@@ -114,6 +129,7 @@ class SelfPlayEngine:
             pend_grid=jnp.zeros((b, n, c, env.rows, env.cols), jnp.float32),
             pend_other=jnp.zeros((b, n, f), jnp.float32),
             pend_policy=jnp.zeros((b, n, a), jnp.float32),
+            pend_pweight=jnp.ones((b, n), jnp.float32),
             pend_return=jnp.zeros((b, n), jnp.float32),
             pend_discount=jnp.ones((b, n), jnp.float32),
             pend_active=jnp.zeros((b, n), bool),
@@ -160,14 +176,38 @@ class SelfPlayEngine:
         n = self.n_step
         w = carry.move_index % n
         states = carry.env
-        rng, k_search, k_select, k_reset = jax.random.split(carry.rng, 4)
+        rng, k_search, k_select, k_reset, k_mode = jax.random.split(
+            carry.rng, 5
+        )
 
         # 1-2. Features for replay + batched search (one MXU leaf batch
-        # per simulation across all B games).
+        # per simulation across all B games). Under playout cap
+        # randomization the whole lockstep move is a full search with
+        # prob `full_search_prob`, else the cheap fast search — a
+        # per-move (not per-game) draw, which keeps the batch lanes in
+        # lockstep while matching KataGo's per-move distribution.
         grids, others = jax.vmap(self.extractor.extract)(states)
-        out = self.mcts._search(variables, states, k_search)
+        if self.mcts_fast is None:
+            out = self.mcts._search(variables, states, k_search)
+            is_full = jnp.bool_(True)
+            sims_this_move = jnp.int32(self.mcts_config.max_simulations)
+        else:
+            is_full = jax.random.bernoulli(
+                k_mode, self.mcts_config.full_search_prob
+            )
+            out = jax.lax.cond(
+                is_full,
+                lambda: self.mcts._search(variables, states, k_search),
+                lambda: self.mcts_fast._search(variables, states, k_search),
+            )
+            sims_this_move = jnp.where(
+                is_full,
+                self.mcts_config.max_simulations,
+                self.mcts_config.fast_simulations,
+            ).astype(jnp.int32)
         valid = jax.vmap(self.env.valid_action_mask)(states)
         policy = policy_target_from_visits(out.visit_counts, valid)
+        pweight = jnp.where(is_full, 1.0, 0.0)
 
         # 3. Mature the slot added n moves ago: bootstrap with this
         # search's root value (the MCTS estimate of V(s_t) = V(s_{t-n+n})).
@@ -176,6 +216,7 @@ class SelfPlayEngine:
             "grid": carry.pend_grid[:, w],
             "other": carry.pend_other[:, w],
             "policy": carry.pend_policy[:, w],
+            "pw": carry.pend_pweight[:, w],
             "ret": carry.pend_return[:, w]
             + carry.pend_discount[:, w] * out.root_value,
             "mask": mat_mask,
@@ -197,6 +238,7 @@ class SelfPlayEngine:
         pend_grid = carry.pend_grid.at[:, w].set(grids)
         pend_other = carry.pend_other.at[:, w].set(others)
         pend_policy = carry.pend_policy.at[:, w].set(policy)
+        pend_pweight = carry.pend_pweight.at[:, w].set(pweight)
         pend_return = carry.pend_return.at[:, w].set(0.0)
         pend_discount = carry.pend_discount.at[:, w].set(1.0)
         pend_active = pend_active.at[:, w].set(True)
@@ -219,6 +261,7 @@ class SelfPlayEngine:
             "grid": pend_grid,
             "other": pend_other,
             "policy": pend_policy,
+            "pw": pend_pweight,
             "ret": pend_return,
             "mask": flush_mask,
         }
@@ -244,6 +287,7 @@ class SelfPlayEngine:
             pend_grid=pend_grid,
             pend_other=pend_other,
             pend_policy=pend_policy,
+            pend_pweight=pend_pweight,
             pend_return=pend_return,
             pend_discount=pend_discount,
             pend_active=pend_active,
@@ -265,6 +309,10 @@ class SelfPlayEngine:
                 # Orphan node slots this search (duplicate/revisited
                 # edges) — the waste the no-tree-reuse design accepts.
                 "wasted_slots": out.wasted_slots,
+                # Playout-cap accounting: sims actually run this move
+                # and whether it was a full (policy-training) search.
+                "sims": sims_this_move,
+                "is_full": is_full,
             },
         }
         return new_carry, outputs
@@ -294,8 +342,10 @@ class SelfPlayEngine:
             self.net.variables, self._carry, jnp.int32(version)
         )
         host = jax.device_get(outputs)  # the one transfer per chunk
+        # Under playout cap randomization the per-move sim count varies;
+        # the trace records what actually ran.
         self._total_simulations += (
-            t * self.batch_size * self.mcts_config.max_simulations
+            int(host["trace"]["sims"].sum()) * self.batch_size
         )
 
         self.last_trace = host["trace"]
@@ -308,6 +358,7 @@ class SelfPlayEngine:
                     mat["other"][mmask],
                     mat["policy"][mmask],
                     mat["ret"][mmask].astype(np.float32),
+                    mat["pw"][mmask].astype(np.float32),
                 )
             )
         fmask = flush["mask"]  # (T, B, n)
@@ -318,6 +369,7 @@ class SelfPlayEngine:
                     flush["other"][fmask],
                     flush["policy"][fmask],
                     flush["ret"][fmask].astype(np.float32),
+                    flush["pw"][fmask].astype(np.float32),
                 )
             )
         ending = episode["ending"]  # (T, B)
@@ -356,17 +408,20 @@ class SelfPlayEngine:
             others = np.concatenate([o[1] for o in self._out])
             policies = np.concatenate([o[2] for o in self._out])
             values = np.concatenate([o[3] for o in self._out])
+            pweights = np.concatenate([o[4] for o in self._out])
         else:
             c, h, w = self._grid_shape
             grids = np.zeros((0, c, h, w), np.float32)
             others = np.zeros((0, self._other_dim), np.float32)
             policies = np.zeros((0, self._action_dim), np.float32)
             values = np.zeros((0,), np.float32)
+            pweights = np.zeros((0,), np.float32)
         result = SelfPlayResult(
             grid=grids,
             other_features=others,
             policy_target=policies,
             value_target=values,
+            policy_weight=pweights,
             episode_scores=self._episode_scores,
             episode_lengths=self._episode_lengths,
             episode_start_versions=self._episode_start_versions,

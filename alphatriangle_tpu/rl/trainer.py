@@ -174,6 +174,7 @@ class Trainer:
             "policy_target": bshard,
             "value_target": bshard,
             "weights": bshard,
+            "policy_weight": bshard,
         }
         self._step_fn = jax.jit(
             self._train_step_impl,
@@ -227,6 +228,11 @@ class Trainer:
 
         log_policy = jax.nn.log_softmax(policy_logits, axis=-1)
         policy_ce = -(batch["policy_target"] * log_policy).sum(axis=-1)  # (B,)
+        # Playout-cap randomization: rows from fast searches carry
+        # policy_weight 0 — their visit counts are too noisy to train
+        # the policy on; they still train the value head below.
+        pw = batch["policy_weight"]
+        policy_ce = pw * policy_ce
 
         target_dist = project_to_support(
             batch["value_target"], self.num_atoms, self.v_min, self.v_max
@@ -235,23 +241,33 @@ class Trainer:
         value_ce = -(target_dist * log_value).sum(axis=-1)  # (B,)
 
         probs = jnp.exp(log_policy)
-        entropy = -(probs * log_policy).sum(axis=-1)  # (B,)
+        # Entropy regularizes the policy, so it follows the policy mask.
+        # The LOSS term averages over all B rows — the same denominator
+        # as the masked policy CE — so the entropy-to-policy-gradient
+        # ratio is invariant to the PCR full-search fraction. The
+        # REPORTED entropy averages over policy-trainable rows only
+        # (interpretable as nats/decision regardless of masking).
+        entropy_rows = -(probs * log_policy).sum(axis=-1)  # (B,)
+        entropy_term = (pw * entropy_rows).mean()
+        entropy_metric = (pw * entropy_rows).sum() / jnp.maximum(
+            pw.sum(), 1.0
+        )
 
         w = batch["weights"]
         per_sample = (
             cfg.POLICY_LOSS_WEIGHT * policy_ce
             + cfg.VALUE_LOSS_WEIGHT * value_ce
         )
-        # Entropy regularization uses the UNWEIGHTED mean — the reference
-        # is explicit about this ("Use mean entropy, not weighted",
-        # `trainer.py:253-256`); IS weights must not modulate the
-        # regularizer's strength per sample.
-        total = (w * per_sample).mean() - cfg.ENTROPY_BONUS_WEIGHT * entropy.mean()
+        # Entropy regularization uses the UNWEIGHTED (by IS weight) mean
+        # — the reference is explicit about this ("Use mean entropy, not
+        # weighted", `trainer.py:253-256`); IS weights must not modulate
+        # the regularizer's strength per sample.
+        total = (w * per_sample).mean() - cfg.ENTROPY_BONUS_WEIGHT * entropy_term
         aux = {
             "total_loss": total,
             "policy_loss": (w * policy_ce).mean(),
             "value_loss": (w * value_ce).mean(),
-            "entropy": entropy.mean(),
+            "entropy": entropy_metric,
             "td_errors": value_ce,
             "batch_stats": new_batch_stats,
         }
@@ -311,6 +327,14 @@ class Trainer:
 
     # --- host API ---------------------------------------------------------
 
+    @staticmethod
+    def _with_policy_weight(batch: dict, n: int) -> dict:
+        """Default the PCR policy-loss mask to ones when absent, so
+        callers that predate playout-cap randomization stay valid."""
+        if "policy_weight" not in batch:
+            batch["policy_weight"] = np.ones(n, dtype=np.float32)
+        return batch
+
     def _check_local_batch(self, n: int) -> None:
         # Multi-process: `batch` is this host's share; it must tile this
         # host's slice of the dp axis (shard_batch assembles the global
@@ -331,7 +355,8 @@ class Trainer:
         if n == 0:
             return None
         self._check_local_batch(n)
-        device_batch = shard_batch(self.mesh, dict(batch), self.dp_axis)
+        batch = self._with_policy_weight(dict(batch), n)
+        device_batch = shard_batch(self.mesh, batch, self.dp_axis)
         self.state, metrics, td = self._step_fn(self.state, device_batch)
         # ONE blocking transfer for everything this step produced
         # (fetching each metric separately costs a round trip apiece).
@@ -365,6 +390,7 @@ class Trainer:
         if n == 0:  # same skip contract as train_step
             return []
         self._check_local_batch(n)
+        batches = [self._with_policy_weight(dict(b), n) for b in batches]
         stacked_host = {
             key: np.stack([np.asarray(b[key]) for b in batches])
             for key in batches[0]

@@ -25,6 +25,10 @@ class SelfPlayResult(BaseModel):
     other_features: np.ndarray  # (N, F) float32
     policy_target: np.ndarray  # (N, A) float32
     value_target: np.ndarray  # (N,) float32 n-step returns
+    # Per-row policy-loss weight: 0.0 for experiences from fast
+    # (playout-cap-randomized) searches whose visit counts are too
+    # noisy to train the policy on; 1.0 otherwise. None -> all ones.
+    policy_weight: np.ndarray | None = None
 
     episode_scores: list[float] = []
     episode_lengths: list[int] = []
@@ -46,6 +50,15 @@ class SelfPlayResult(BaseModel):
     @model_validator(mode="after")
     def _drop_invalid_rows(self) -> "SelfPlayResult":
         n = self.grid.shape[0]
+        if self.policy_weight is None:
+            object.__setattr__(
+                self, "policy_weight", np.ones(n, dtype=np.float32)
+            )
+        assert self.policy_weight is not None
+        if self.policy_weight.shape[0] != n:
+            raise ValueError(
+                f"policy_weight rows {self.policy_weight.shape[0]} != {n}"
+            )
         if not (
             self.other_features.shape[0]
             == self.policy_target.shape[0]
@@ -77,4 +90,5 @@ class SelfPlayResult(BaseModel):
             object.__setattr__(self, "other_features", self.other_features[keep])
             object.__setattr__(self, "policy_target", self.policy_target[keep])
             object.__setattr__(self, "value_target", self.value_target[keep])
+            object.__setattr__(self, "policy_weight", self.policy_weight[keep])
         return self

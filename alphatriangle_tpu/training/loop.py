@@ -108,6 +108,7 @@ class TrainingLoop:
             result.other_features,
             result.policy_target,
             result.value_target,
+            policy_weight=result.policy_weight,
         )
         self.episodes_played += result.num_episodes
         self.total_simulations += result.total_simulations
@@ -160,8 +161,16 @@ class TrainingLoop:
             events += [
                 RawMetricEvent(
                     name="SelfPlay/Wasted_Slot_Fraction",
-                    value=float(np.mean(trace["wasted_slots"]))
-                    / c.self_play.mcts_config.max_simulations,
+                    # Normalize per move by the sims that actually ran
+                    # (varies per move under playout cap randomization).
+                    value=float(
+                        np.mean(
+                            trace["wasted_slots"]
+                            / np.maximum(
+                                np.asarray(trace["sims"])[:, None], 1
+                            )
+                        )
+                    ),
                     global_step=step,
                 ),
                 RawMetricEvent(
@@ -175,6 +184,18 @@ class TrainingLoop:
                     global_step=step,
                 ),
             ]
+            if c.self_play.mcts_fast is not None:
+                # Playout-cap randomization: achieved full-search rate
+                # this chunk (target = MCTSConfig.full_search_prob).
+                # Gated on PCR being enabled — without it the fraction
+                # is a constant 1.0 and only pollutes dashboards.
+                events.append(
+                    RawMetricEvent(
+                        name="SelfPlay/Full_Search_Fraction",
+                        value=float(np.mean(trace["is_full"])),
+                        global_step=step,
+                    )
+                )
         c.stats.log_batch_events(events)
         self.experiences_added += result.num_experiences
         return result.num_experiences

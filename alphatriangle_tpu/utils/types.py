@@ -48,6 +48,7 @@ class DenseBatch(TypedDict):
     policy_target: np.ndarray  # (B, A) float32, rows sum to 1
     value_target: np.ndarray  # (B,) float32 n-step returns
     weights: np.ndarray  # (B,) float32 IS weights (ones if uniform)
+    policy_weight: np.ndarray  # (B,) float32 policy-loss mask (PCR)
 
 
 def dense_policy_from_mapping(mapping: PolicyTargetMapping, action_dim: int) -> np.ndarray:

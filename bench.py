@@ -176,12 +176,21 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
             COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
         )
+        mcts_kw: dict = {}
+        if os.environ.get("BENCH_FAST_SIMS"):
+            # Playout cap randomization A/B (KataGo; docs in
+            # config/mcts_config.py): BENCH_FAST_SIMS=16 [BENCH_FULL_PROB=0.25]
+            mcts_kw["fast_simulations"] = int(os.environ["BENCH_FAST_SIMS"])
+            mcts_kw["full_search_prob"] = float(
+                os.environ.get("BENCH_FULL_PROB", "0.25")
+            )
         mcts_cfg = AlphaTriangleMCTSConfig(
             max_simulations=sims,
             max_depth=depth,
             # A/B knob for the descent row-gather lowering
             # (ops/gather_rows.py).
             descent_gather=os.environ.get("BENCH_GATHER", "einsum"),
+            **mcts_kw,
         )
         train_cfg = TrainConfig(
             SELF_PLAY_BATCH_SIZE=sp_batch,
@@ -216,8 +225,11 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     result = engine.harvest()
     episodes = result.num_episodes
     games_per_hour = episodes / elapsed * 3600.0
-    sims = mcts_cfg.max_simulations
-    leaf_evals_per_sec = moves * sp_batch * (sims + 1) / elapsed
+    # Engine-reported sims (exact under playout cap randomization too)
+    # + one root eval per move.
+    leaf_evals_per_sec = (
+        result.total_simulations + moves * sp_batch
+    ) / elapsed
     moves_per_sec = moves * sp_batch / elapsed
     log(
         f"bench: {moves} lockstep moves x {sp_batch} games in {elapsed:.1f}s "

@@ -101,6 +101,69 @@ class TestBasics:
         assert engine.harvest().trainer_step_at_episode_start == v0 + 1
 
 
+class TestPlayoutCapRandomization:
+    """KataGo-style PCR (config/mcts_config.py): fast moves carry
+    policy weight 0; accounting reflects the sims actually run."""
+
+    def make_pcr_engine(self, world, prob=0.5):
+        env, fe, net, mcts_cfg = world
+        pcr_cfg = type(mcts_cfg)(
+            **{
+                **mcts_cfg.model_dump(),
+                "fast_simulations": max(
+                    2, mcts_cfg.max_simulations // 4
+                ),
+                "full_search_prob": prob,
+            }
+        )
+        return make_engine((env, fe, net, pcr_cfg))
+
+    def test_policy_weights_mark_fast_moves(self, world):
+        engine, _ = self.make_pcr_engine(world, prob=0.5)
+        engine.play_chunk(24)
+        trace = engine.last_trace
+        assert trace is not None and "is_full" in trace
+        fulls = np.asarray(trace["is_full"])
+        # 24 Bernoulli(0.5) draws: both kinds appear with prob ~1-6e-8.
+        assert 0 < fulls.sum() < fulls.size
+        result = engine.harvest()
+        pw = result.policy_weight
+        assert pw is not None and set(np.unique(pw)) <= {0.0, 1.0}
+        assert 0 < pw.sum() < pw.size  # both kinds reached the replay
+
+    def test_sims_accounting_matches_trace(self, world):
+        engine, _ = self.make_pcr_engine(world, prob=0.5)
+        engine.play_chunk(10)
+        trace = engine.last_trace
+        expected = int(np.asarray(trace["sims"]).sum()) * engine.batch_size
+        assert engine.harvest().total_simulations == expected
+        full = engine.mcts_config.max_simulations
+        fast = engine.mcts_config.fast_simulations
+        assert set(np.unique(np.asarray(trace["sims"]))) <= {full, fast}
+
+    def test_disabled_by_default(self, world):
+        engine, _ = make_engine(world)
+        assert engine.mcts_fast is None
+        result = engine.play_moves(6)
+        assert np.all(result.policy_weight == 1.0)
+
+    def test_buffer_roundtrip_preserves_weights(self, world):
+        engine, tc = self.make_pcr_engine(world, prob=0.5)
+        result = engine.play_moves(24)
+        buf = ExperienceBuffer(tc, action_dim=result.policy_target.shape[1])
+        buf.add_dense(
+            result.grid,
+            result.other_features,
+            result.policy_target,
+            result.value_target,
+            policy_weight=result.policy_weight,
+        )
+        sample = buf.sample(8)
+        assert sample is not None
+        pw = sample["batch"]["policy_weight"]
+        assert pw.shape == (8,) and set(np.unique(pw)) <= {0.0, 1.0}
+
+
 class TestNStepMath:
     def test_window_matches_reference_deque(self, world):
         """Replay the engine's own per-move (reward, root_value, ending)

@@ -163,6 +163,46 @@ class TestTrainStep:
         )
 
 
+class TestPolicyWeightMask:
+    def test_zero_policy_weight_rows_drop_policy_loss(
+        self, network, tiny_train_config
+    ):
+        """Rows with policy_weight 0 (fast PCR searches) contribute no
+        policy CE or entropy; the value head still trains on them."""
+        trainer = Trainer(network, tiny_train_config)
+        batch = make_batch()
+        batch["policy_weight"] = np.zeros(B, dtype=np.float32)
+        out = trainer.train_step(batch)
+        assert out is not None
+        metrics = out[0]
+        assert metrics["policy_loss"] == pytest.approx(0.0, abs=1e-12)
+        assert metrics["entropy"] == pytest.approx(0.0, abs=1e-12)
+        assert metrics["value_loss"] > 0.0
+
+    def test_mixed_weights_match_subset(self, tiny_model_config, tiny_env_config, tiny_train_config):
+        """policy_loss with half the rows masked equals the IS-weighted
+        mean over all rows with masked rows as zeros."""
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer = Trainer(net, tiny_train_config)
+        batch = make_batch()
+        pw = np.zeros(B, dtype=np.float32)
+        pw[: B // 2] = 1.0
+        batch["policy_weight"] = pw
+        metrics, _ = trainer.train_step(batch)
+
+        net2 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        trainer2 = Trainer(net2, tiny_train_config)
+        full_metrics, _ = trainer2.train_step(make_batch())
+        # Same data, same params: the masked run's policy loss must be
+        # strictly less than the unmasked run's (half the rows zeroed).
+        assert 0.0 < metrics["policy_loss"] < full_metrics["policy_loss"]
+
+    def test_absent_key_defaults_to_ones(self, network, tiny_train_config):
+        trainer = Trainer(network, tiny_train_config)
+        out = trainer.train_step(make_batch())  # no policy_weight key
+        assert out is not None and out[0]["policy_loss"] > 0.0
+
+
 class TestFusedSteps:
     """`train_steps` (FUSED_LEARNER_STEPS) must be a pure dispatch
     optimization: K fused steps == K sequential steps."""
