@@ -86,6 +86,12 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         help="Probability a move runs the full search under playout "
         "cap randomization (default 0.25).",
     )
+    p.add_argument(
+        "--gumbel",
+        action="store_true",
+        help="Gumbel root search with sequential halving instead of "
+        "PUCT+Dirichlet (stronger at small sim budgets).",
+    )
     p.add_argument("--no-per", action="store_true")
     p.add_argument(
         "--no-auto-resume",
@@ -187,7 +193,11 @@ def cmd_train(args: argparse.Namespace) -> int:
     else:
         train_config = TrainConfig(**overrides)
 
-    if args.fast_sims is not None or args.full_search_prob is not None:
+    if (
+        args.fast_sims is not None
+        or args.full_search_prob is not None
+        or args.gumbel
+    ):
         from .config import AlphaTriangleMCTSConfig
 
         mcts_kw = mcts_config.model_dump() if mcts_config else {}
@@ -195,7 +205,12 @@ def cmd_train(args: argparse.Namespace) -> int:
             mcts_kw["fast_simulations"] = args.fast_sims
         if args.full_search_prob is not None:
             mcts_kw["full_search_prob"] = args.full_search_prob
-        if mcts_kw.get("fast_simulations") is None:
+        if args.gumbel:
+            mcts_kw["root_selection"] = "gumbel"
+        if (
+            args.full_search_prob is not None
+            and mcts_kw.get("fast_simulations") is None
+        ):
             raise SystemExit(
                 "--full-search-prob has no effect without --fast-sims "
                 "(playout cap randomization stays disabled)."

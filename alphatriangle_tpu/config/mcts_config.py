@@ -52,6 +52,18 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # fast budget while policy targets keep full-search quality.
     fast_simulations: int | None = Field(default=None, gt=0)
     full_search_prob: float = Field(default=0.25, gt=0, le=1.0)
+    # --- Gumbel root search (Danihelka et al. 2022 / mctx; beyond-
+    # reference, mcts/gumbel.py). "gumbel": root actions are explored
+    # by sampled Gumbel noise + sequential halving across waves, the
+    # played move is the final-candidate argmax (no temperature), and
+    # policy targets are the completed-Q improved policy. "puct":
+    # reference-parity Dirichlet + visit-count behavior.
+    root_selection: str = Field(default="puct", pattern="^(puct|gumbel)$")
+    # Max root candidates considered by sequential halving.
+    gumbel_m: int = Field(default=16, gt=1)
+    # sigma(q) = (c_visit + max_visits) * c_scale * q   (paper Eq. 8).
+    gumbel_c_visit: float = Field(default=50.0, ge=0)
+    gumbel_c_scale: float = Field(default=0.1, gt=0)
 
     @model_validator(mode="after")
     def _check_fast(self) -> "AlphaTriangleMCTSConfig":

@@ -9,6 +9,7 @@ MXU — the architectural change BASELINE.md names as the games/hour
 make-or-break.
 """
 
+from .gumbel import GumbelMCTS
 from .helpers import (
     PolicyGenerationError,
     policy_target_from_visits,
@@ -18,6 +19,7 @@ from .search import BatchedMCTS, SearchOutput
 
 __all__ = [
     "BatchedMCTS",
+    "GumbelMCTS",
     "PolicyGenerationError",
     "SearchOutput",
     "policy_target_from_visits",

@@ -81,6 +81,13 @@ class SearchOutput:
     root_prior: jax.Array  # (B, A) float32 noisy root prior (debug)
     total_simulations: jax.Array  # () int32
     wasted_slots: jax.Array  # (B,) int32 orphan node slots (see module doc)
+    # Gumbel root search outputs (mcts/gumbel.py). PUCT fills
+    # sentinels so both search kinds share one pytree structure (the
+    # playout-cap lax.cond needs matching branches):
+    # selected_action -1 = "select from visit counts on the host path";
+    # improved_policy zeros = "build the target from visit counts".
+    selected_action: jax.Array  # (B,) int32
+    improved_policy: jax.Array  # (B, A) float32
 
 
 class BatchedMCTS:
@@ -189,7 +196,13 @@ class BatchedMCTS:
             root_value0=root_value,
         )
 
-    def _descend_wave(self, tree: Tree, wave_rng: jax.Array, batch: int):
+    def _descend_wave(
+        self,
+        tree: Tree,
+        wave_rng: jax.Array,
+        batch: int,
+        root_action: jax.Array | None = None,
+    ):
         """W parallel recorded descents per tree.
 
         Returns a dict of (B, W[, D]) arrays: final (parent, action,
@@ -197,6 +210,11 @@ class BatchedMCTS:
         traversal rewards, active mask) for backup. Gumbel score noise
         (`wave_noise_scale`) is sampled per level from `wave_rng` so
         no (B, W, D, A) tensor is ever materialized.
+
+        `root_action` (B, W) int32, when given, forces each member's
+        depth-0 action (the Gumbel sequential-halving allocation,
+        mcts/gumbel.py); -1 entries are unforced (ordinary PUCT), and
+        deeper levels always select by PUCT.
         """
         cfg = self.config
         w, a = self.wave_size, self.action_dim
@@ -248,6 +266,9 @@ class BatchedMCTS:
                 noise = 0.0
             scores = jnp.where(valid_r > 0, q + u, -jnp.inf) + noise
             act = jnp.argmax(scores, axis=-1).astype(jnp.int32)  # (B, W)
+            if root_action is not None:
+                # -1 releases a member to ordinary PUCT selection.
+                act = jnp.where((d == 0) & (root_action >= 0), root_action, act)
             act_oh = jax.nn.one_hot(act, a, dtype=jnp.float32)
             child = (
                 (child_r * act_oh).sum(axis=-1).astype(jnp.int32)
@@ -308,7 +329,7 @@ class BatchedMCTS:
             "rec_active": rec_active,
         }
 
-    def _wave(self, variables, batch: int, carry, wave_rng):
+    def _wave(self, variables, batch: int, carry, wave_rng, root_action=None):
         """One wave: W parallel sims across all B trees."""
         cfg = self.config
         tree, wasted, base = carry
@@ -319,7 +340,7 @@ class BatchedMCTS:
         bcol = barange[:, None]
 
         # 1. W parallel recorded descents per tree.
-        d = self._descend_wave(tree, wave_rng, batch)
+        d = self._descend_wave(tree, wave_rng, batch, root_action)
         parents, actions, existing = d["parents"], d["actions"], d["existing"]
         is_new = existing < 0
 
@@ -448,4 +469,6 @@ class BatchedMCTS:
             root_prior=tree.prior[:, 0],
             total_simulations=jnp.int32(cfg.max_simulations * batch),
             wasted_slots=wasted,
+            selected_action=jnp.full((batch,), -1, jnp.int32),
+            improved_policy=jnp.zeros_like(visit_counts),
         )

@@ -48,6 +48,7 @@ from ..config.mcts_config import MCTSConfig
 from ..config.train_config import TrainConfig
 from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
+from ..mcts.gumbel import GumbelMCTS
 from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
 from ..mcts.search import BatchedMCTS
 from ..nn.network import NeuralNetwork
@@ -89,7 +90,10 @@ class SelfPlayEngine:
         self.env = env
         self.extractor = extractor
         self.net = net
-        self.mcts = BatchedMCTS(
+        search_cls = (
+            GumbelMCTS if mcts_config.root_selection == "gumbel" else BatchedMCTS
+        )
+        self.mcts = search_cls(
             env, extractor, net.model, mcts_config, net.support
         )
         # Playout cap randomization (KataGo, arXiv:1902.10565 §3.1):
@@ -103,7 +107,7 @@ class SelfPlayEngine:
                     "dirichlet_epsilon": 0.0,
                 }
             )
-            self.mcts_fast = BatchedMCTS(
+            self.mcts_fast = search_cls(
                 env, extractor, net.model, fast_cfg, net.support
             )
         self.config = train_config
@@ -206,7 +210,12 @@ class SelfPlayEngine:
                 self.mcts_config.fast_simulations,
             ).astype(jnp.int32)
         valid = jax.vmap(self.env.valid_action_mask)(states)
-        policy = policy_target_from_visits(out.visit_counts, valid)
+        if self.mcts_config.root_selection == "gumbel":
+            # Completed-Q improved policy (mcts/gumbel.py) — a policy-
+            # improvement operator, not a visit histogram.
+            policy = out.improved_policy
+        else:
+            policy = policy_target_from_visits(out.visit_counts, valid)
         pweight = jnp.where(is_full, 1.0, 0.0)
 
         # 3. Mature the slot added n moves ago: bootstrap with this
@@ -223,10 +232,17 @@ class SelfPlayEngine:
         }
         pend_active = carry.pend_active.at[:, w].set(False)
 
-        # 4. Select actions (temperature by each game's own move count)
-        # and step all games in one vmapped transition.
-        temps = self._temperatures(states.step_count)
-        actions = select_action_from_visits(out.visit_counts, temps, k_select)
+        # 4. Select actions and step all games in one vmapped
+        # transition. PUCT: temperature-scheduled sampling from visit
+        # counts; Gumbel: the search already resolved the argmax of
+        # g + logits + sigma(q) (exploration IS the Gumbel sample).
+        if self.mcts_config.root_selection == "gumbel":
+            actions = out.selected_action
+        else:
+            temps = self._temperatures(states.step_count)
+            actions = select_action_from_visits(
+                out.visit_counts, temps, k_select
+            )
         # Sentinel guard: -1 (zero root visits) only happens for finished
         # games, where step() is a no-op; count live-game sentinels so the
         # host can surface the anomaly instead of silently clamping.

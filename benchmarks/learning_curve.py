@@ -66,7 +66,15 @@ def build():
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
     )
     mcts_cfg = AlphaTriangleMCTSConfig(
-        max_simulations=16, max_depth=6, mcts_batch_size=8
+        max_simulations=16,
+        max_depth=6,
+        mcts_batch_size=8,
+        # LEARN_GUMBEL=1 A/Bs the Gumbel sequential-halving root
+        # (mcts/gumbel.py) against reference-parity PUCT.
+        root_selection=(
+            "gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else "puct"
+        ),
+        gumbel_m=8,
     )
     train_cfg = TrainConfig(
         SELF_PLAY_BATCH_SIZE=32,
@@ -211,7 +219,9 @@ def main() -> None:
         results["greedy_initial"] = eval_points[0][1]
         results["greedy_final"] = eval_points[-1][1]
         results["improved"] = eval_points[-1][1] > eval_points[0][1]
-    out_path = Path(__file__).parent / "learning_curve_results.json"
+    suffix = "_gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else ""
+    results["root_selection"] = "gumbel" if suffix else "puct"
+    out_path = Path(__file__).parent / f"learning_curve_results{suffix}.json"
     out_path.write_text(json.dumps(results, indent=2))
     print(json.dumps(results))
 

@@ -1,0 +1,159 @@
+"""Gumbel sequential-halving root search (mcts/gumbel.py).
+
+Contract tests: candidate budgeting, improved-policy distribution
+validity, valid/selected-action consistency, determinism, and the
+self-play integration (policy targets come from completed-Q, actions
+from the final-candidate argmax).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import TrainConfig
+from alphatriangle_tpu.env.engine import TriangleEnv
+from alphatriangle_tpu.features.core import get_feature_extractor
+from alphatriangle_tpu.mcts import GumbelMCTS
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.rl import SelfPlayEngine
+
+B = 4
+
+
+@pytest.fixture(scope="module")
+def gumbel_world(tiny_env_config, tiny_model_config, tiny_mcts_config):
+    env = TriangleEnv(tiny_env_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+    cfg = type(tiny_mcts_config)(
+        **{
+            **tiny_mcts_config.model_dump(),
+            "root_selection": "gumbel",
+            "gumbel_m": 4,
+        }
+    )
+    mcts = GumbelMCTS(env, fe, net.model, cfg, net.support)
+    return env, fe, net, cfg, mcts
+
+
+def run_search(gumbel_world, seed=0):
+    env, fe, net, cfg, mcts = gumbel_world
+    keys = jax.random.split(jax.random.PRNGKey(seed), B)
+    states = env.reset_batch(keys)
+    out = mcts.search(
+        net.variables, states, jax.random.PRNGKey(seed + 100)
+    )
+    return env, states, out
+
+
+class TestGumbelSearch:
+    def test_candidates_bound_visited_actions(self, gumbel_world):
+        """Sequential halving only ever visits the m initial
+        candidates at the root."""
+        _, _, out = run_search(gumbel_world)
+        visited = np.asarray(out.visit_counts > 0)
+        assert (visited.sum(axis=1) <= 4).all()  # gumbel_m = 4
+        assert (visited.sum(axis=1) >= 1).all()
+
+    def test_improved_policy_is_valid_distribution(self, gumbel_world):
+        env, states, out = run_search(gumbel_world)
+        improved = np.asarray(out.improved_policy)
+        valid = np.asarray(jax.vmap(env.valid_action_mask)(states))
+        np.testing.assert_allclose(improved.sum(axis=1), 1.0, atol=1e-5)
+        assert (improved >= 0).all()
+        # No mass outside the valid action set.
+        assert (improved[~valid] == 0).all()
+        # Improved policy covers ALL valid actions (completed-Q), not
+        # just the visited candidates — this is the point of the
+        # policy-improvement operator.
+        assert (improved[valid] > 0).all()
+
+    def test_selected_action_is_valid(self, gumbel_world):
+        env, states, out = run_search(gumbel_world)
+        sel = np.asarray(out.selected_action)
+        valid = np.asarray(jax.vmap(env.valid_action_mask)(states))
+        done = np.asarray(states.done)
+        for b in range(B):
+            if not done[b]:
+                assert sel[b] >= 0 and valid[b, sel[b]]
+
+    def test_deterministic_given_seed(self, gumbel_world):
+        _, _, out1 = run_search(gumbel_world, seed=3)
+        _, _, out2 = run_search(gumbel_world, seed=3)
+        np.testing.assert_array_equal(
+            np.asarray(out1.selected_action), np.asarray(out2.selected_action)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out1.improved_policy),
+            np.asarray(out2.improved_policy),
+        )
+
+    def test_small_wave_never_plays_unsimulated_action(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        """Regression: with wave_size < gumbel_m the candidate set must
+        clamp to the wave size so the played action always has real
+        simulations behind it (candidates outside the wave budget used
+        to be halved/selected on sigma(q)=0 without ever being run)."""
+        env = TriangleEnv(tiny_env_config)
+        fe = get_feature_extractor(env, tiny_model_config)
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        cfg = type(tiny_mcts_config)(
+            **{
+                **tiny_mcts_config.model_dump(),
+                "root_selection": "gumbel",
+                "gumbel_m": 8,
+                "max_simulations": 8,
+                "mcts_batch_size": 2,  # wave_size 2 << gumbel_m
+            }
+        )
+        mcts = GumbelMCTS(env, fe, net.model, cfg, net.support)
+        keys = jax.random.split(jax.random.PRNGKey(5), B)
+        states = env.reset_batch(keys)
+        out = mcts.search(net.variables, states, jax.random.PRNGKey(9))
+        sel = np.asarray(out.selected_action)
+        visits = np.asarray(out.visit_counts)
+        done = np.asarray(states.done)
+        for b in range(B):
+            if not done[b]:
+                assert visits[b, sel[b]] > 0, (b, sel[b], visits[b])
+
+    def test_no_dirichlet_noise_applied(self, gumbel_world):
+        """GumbelMCTS zeroes dirichlet_epsilon internally."""
+        *_, mcts = gumbel_world
+        assert mcts.config.dirichlet_epsilon == 0.0
+
+
+class TestGumbelSelfPlay:
+    def test_end_to_end_rollout(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        env = TriangleEnv(tiny_env_config)
+        fe = get_feature_extractor(env, tiny_model_config)
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        cfg = type(tiny_mcts_config)(
+            **{
+                **tiny_mcts_config.model_dump(),
+                "root_selection": "gumbel",
+                "gumbel_m": 4,
+            }
+        )
+        tc = TrainConfig(
+            BATCH_SIZE=4,
+            BUFFER_CAPACITY=5000,
+            MIN_BUFFER_SIZE_TO_TRAIN=8,
+            USE_PER=False,
+            N_STEP_RETURNS=2,
+            MAX_EPISODE_MOVES=30,
+            SELF_PLAY_BATCH_SIZE=4,
+            MAX_TRAINING_STEPS=100,
+            RUN_NAME="gumbel_sp",
+        )
+        engine = SelfPlayEngine(env, fe, net, cfg, tc, seed=11)
+        assert isinstance(engine.mcts, GumbelMCTS)
+        result = engine.play_moves(12)
+        assert result.num_experiences > 0
+        np.testing.assert_allclose(
+            result.policy_target.sum(axis=1), 1.0, atol=1e-4
+        )
+        assert np.all(np.isfinite(result.value_target))
