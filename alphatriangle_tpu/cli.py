@@ -66,6 +66,14 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         "-gated learner (see --replay-ratio).",
     )
     p.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="Independent rollout streams in overlapped mode (the "
+        "reference's self-play worker count).",
+    )
+    p.add_argument(
         "--replay-ratio",
         type=float,
         default=None,
@@ -165,6 +173,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["FUSED_LEARNER_STEPS"] = args.fused_learner_steps
     if args.async_rollouts:
         overrides["ASYNC_ROLLOUTS"] = True
+    if args.workers is not None:
+        overrides["NUM_SELF_PLAY_WORKERS"] = args.workers
     if args.replay_ratio is not None:
         overrides["REPLAY_RATIO"] = args.replay_ratio
     if args.no_per:

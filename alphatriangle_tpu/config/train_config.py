@@ -36,8 +36,13 @@ class TrainConfig(BaseModel):
     SELF_PLAY_BATCH_SIZE: int = Field(default=512, ge=1)
     # Moves played per jitted rollout dispatch before results return to host.
     ROLLOUT_CHUNK_MOVES: int = Field(default=16, ge=1)
-    # Parity alias for the reference knob: host-side actor threads that
-    # each drive an independent rollout stream (overlap host/device work).
+    # The reference's worker-count knob, re-expressed: in overlapped
+    # mode (ASYNC_ROLLOUTS) this many independent rollout streams run,
+    # each a producer thread driving its own SELF_PLAY_BATCH_SIZE-lane
+    # engine (own PRNG stream + game carry, shared weights), all
+    # feeding one harvest queue. Streams pipeline host-side harvest
+    # compaction against device compute. Ignored by the synchronous
+    # loop (one stream).
     NUM_SELF_PLAY_WORKERS: int = Field(default=1, ge=1)
     WORKER_UPDATE_FREQ_STEPS: int = Field(default=10, ge=1)
     # Hard cap on moves per episode (safety net for jitted rollouts).

@@ -17,6 +17,7 @@ TPU-native equivalent of the reference's worker profiling
 
 import json
 import logging
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -26,11 +27,18 @@ logger = logging.getLogger(__name__)
 
 
 class PhaseTimers:
-    """Accumulates wall-clock seconds per named phase."""
+    """Accumulates wall-clock seconds per named phase.
+
+    Thread-safe: multiple rollout-producer threads time the same
+    "rollout" phase concurrently (training/loop.py), so the
+    accumulation is locked (a bare `dict[k] += dt` would lose
+    increments across interleaved read-modify-writes).
+    """
 
     def __init__(self) -> None:
         self._total: dict[str, float] = defaultdict(float)
         self._count: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     @contextmanager
     def phase(self, name: str):
@@ -38,8 +46,10 @@ class PhaseTimers:
         try:
             yield
         finally:
-            self._total[name] += time.perf_counter() - t0
-            self._count[name] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._total[name] += dt
+                self._count[name] += 1
 
     def metrics(self) -> dict[str, float]:
         """Mean milliseconds per phase, for the stats pipeline."""

@@ -86,7 +86,14 @@ class SelfPlayEngine:
         train_config: TrainConfig,
         batch_size: int | None = None,
         seed: int = 0,
+        share_compiled: "SelfPlayEngine | None" = None,
     ):
+        """`share_compiled`: another engine whose jitted chunk programs
+        this one reuses (multi-stream rollouts, training/loop.py). The
+        rollout computation depends only on configs — carry, weights
+        and version are arguments — so identically-configured streams
+        must not compile the heaviest program in the codebase N times.
+        """
         self.env = env
         self.extractor = extractor
         self.net = net
@@ -143,12 +150,24 @@ class SelfPlayEngine:
 
         # One compiled program per distinct chunk length, carry donated
         # so XLA reuses the window buffers in place.
-        self._chunk_fn = functools.lru_cache(maxsize=None)(
-            lambda num_moves: jax.jit(
-                functools.partial(self._chunk, num_moves),
-                donate_argnums=(1,),
+        if share_compiled is not None:
+            if (
+                share_compiled.batch_size != self.batch_size
+                or share_compiled.mcts_config != self.mcts_config
+                or share_compiled.config != self.config
+            ):
+                raise ValueError(
+                    "share_compiled requires identically-configured "
+                    "engines (batch size / MCTS / train configs)."
+                )
+            self._chunk_fn = share_compiled._chunk_fn
+        else:
+            self._chunk_fn = functools.lru_cache(maxsize=None)(
+                lambda num_moves: jax.jit(
+                    functools.partial(self._chunk, num_moves),
+                    donate_argnums=(1,),
+                )
             )
-        )
 
         # Oldest weights version contributing to the current harvest
         # window (conservative chunk-level tag; per-episode tags ride in

@@ -100,8 +100,13 @@ class TrainingLoop:
         result = self.c.self_play.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
         return self._fold_result(result)
 
-    def _fold_result(self, result) -> int:
-        """Fold one self-play harvest into the buffer + metrics."""
+    def _fold_result(self, result, trace=None) -> int:
+        """Fold one self-play harvest into the buffer + metrics.
+
+        `trace` is the producing engine's per-chunk diagnostics; when
+        None (sync mode, single producer) the primary engine's
+        `last_trace` is read directly.
+        """
         c = self.c
         c.buffer.add_dense(
             result.grid,
@@ -152,7 +157,8 @@ class TrainingLoop:
                     global_step=step,
                 ),
             ]
-        trace = getattr(c.self_play, "last_trace", None)
+        if trace is None:
+            trace = getattr(c.self_play, "last_trace", None)
         if trace is not None and "wasted_slots" in trace:
             # Per-move diagnostics, chunk-aggregated (the reference's
             # per-move mcts_step/step_reward events, `worker.py:141-164`,
@@ -454,28 +460,30 @@ class TrainingLoop:
 
     # --- overlapped producer/consumer ------------------------------------
 
-    def _producer_loop(self, out: "queue.Queue") -> None:
-        """Self-play producer: play chunks, enqueue harvests.
+    def _producer_loop(self, engine, out: "queue.Queue") -> None:
+        """Self-play producer: play chunks, enqueue (harvest, trace).
 
-        Runs in a daemon thread. JAX dispatch is thread-safe; device
-        compute serializes with the learner's, but the host-side work
-        on both sides (harvest compaction here, PER sampling/priority
-        updates there) now overlaps with it. Weight syncs are picked up
-        at the next chunk via `net.variables` (no broadcast; replaces
-        reference `worker_manager.py:169-209`).
+        Runs in a daemon thread (one per rollout stream — the
+        reference's NUM_SELF_PLAY_WORKERS actors, `setup.py:106-151`,
+        become N independent device-batched streams sharing one queue).
+        JAX dispatch is thread-safe; device compute serializes with the
+        learner's, but the host-side work on all sides (harvest
+        compaction here, PER sampling/priority updates there) overlaps
+        with it. Weight syncs are picked up at the next chunk via
+        `net.variables` (no broadcast; replaces reference
+        `worker_manager.py:169-209`).
         """
         try:
             while not self.stop_event.is_set():
-                # Timed as "rollout" here — in async mode the producer
-                # owns the self-play device time; the consumer's queue
+                # Timed as "rollout" here — in async mode the producers
+                # own the self-play device time; the consumer's queue
                 # drain is timed separately as "fold".
                 with self.profile.phase("rollout"):
-                    result = self.c.self_play.play_moves(
-                        self.cfg.ROLLOUT_CHUNK_MOVES
-                    )
+                    result = engine.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
+                item = (result, engine.last_trace)
                 while not self.stop_event.is_set():
                     try:
-                        out.put(result, timeout=0.2)
+                        out.put(item, timeout=0.2)
                         break
                     except queue.Full:
                         continue
@@ -495,16 +503,41 @@ class TrainingLoop:
         )
         return max(0, int(target) - self._steps_this_run)
 
+    def _make_rollout_streams(self) -> list:
+        """The primary engine plus NUM_SELF_PLAY_WORKERS-1 extra
+        independent streams (own carry + seed, shared net/weights)."""
+        from ..rl.self_play import SelfPlayEngine
+
+        primary = self.c.self_play
+        streams = [primary]
+        for i in range(1, self.cfg.NUM_SELF_PLAY_WORKERS):
+            streams.append(
+                SelfPlayEngine(
+                    primary.env,
+                    primary.extractor,
+                    primary.net,
+                    primary.mcts_config,
+                    primary.config,
+                    seed=self.cfg.RANDOM_SEED + 1000 + i,
+                    share_compiled=primary,
+                )
+            )
+        return streams
+
     def _run_async(self) -> None:
         cfg = self.cfg
         harvests: "queue.Queue" = queue.Queue(maxsize=cfg.ROLLOUT_QUEUE_MAX)
-        producer = threading.Thread(
-            target=self._producer_loop,
-            args=(harvests,),
-            name="self-play-producer",
-            daemon=True,
-        )
-        producer.start()
+        producers = [
+            threading.Thread(
+                target=self._producer_loop,
+                args=(engine, harvests),
+                name=f"self-play-producer-{i}",
+                daemon=True,
+            )
+            for i, engine in enumerate(self._make_rollout_streams())
+        ]
+        for producer in producers:
+            producer.start()
         iteration = 0
         try:
             while not self.stop_event.is_set():
@@ -522,7 +555,7 @@ class TrainingLoop:
                 with self.profile.phase("fold"):
                     while True:
                         try:
-                            self._fold_result(harvests.get_nowait())
+                            self._fold_result(*harvests.get_nowait())
                             folded += 1
                         except queue.Empty:
                             break
@@ -535,7 +568,7 @@ class TrainingLoop:
                         )
                     ):
                         try:
-                            self._fold_result(harvests.get(timeout=0.5))
+                            self._fold_result(*harvests.get(timeout=0.5))
                             folded += 1
                         except queue.Empty:
                             pass
@@ -562,14 +595,17 @@ class TrainingLoop:
                 self._iteration_tail()
         finally:
             self.stop_event.set()
-            producer.join(timeout=30.0)
-            if producer.is_alive():
-                logger.warning("Self-play producer did not join within 30s.")
+            for producer in producers:
+                producer.join(timeout=30.0)
+                if producer.is_alive():
+                    logger.warning(
+                        "%s did not join within 30s.", producer.name
+                    )
             # Fold any harvests still queued so the final checkpoint /
             # buffer spill includes everything that was actually played.
             while True:
                 try:
-                    self._fold_result(harvests.get_nowait())
+                    self._fold_result(*harvests.get_nowait())
                 except queue.Empty:
                     break
             if self._producer_error is not None:

@@ -66,6 +66,44 @@ class TestTrainOverrides:
         assert captured["use_tensorboard"] is False
         assert captured["log_level"] == "WARNING"
 
+    def test_search_and_preset_flags(self, monkeypatch):
+        captured = self._capture(monkeypatch)
+        rc = cli.main(
+            [
+                "train",
+                "--preset", "2",
+                "--max-steps", "50",
+                "--gumbel",
+                "--fast-sims", "16",
+                "--full-search-prob", "0.5",
+                "--fused-learner-steps", "4",
+                "--async-rollouts",
+                "--replay-ratio", "2.0",
+                "--no-tensorboard",
+            ]
+        )
+        assert rc == 0
+        tc = captured["train_config"]
+        assert tc.MAX_TRAINING_STEPS == 50
+        # Derived schedule lengths re-derive from the overridden horizon.
+        assert tc.LR_SCHEDULER_T_MAX == 50
+        assert tc.FUSED_LEARNER_STEPS == 4
+        assert tc.ASYNC_ROLLOUTS is True
+        assert tc.REPLAY_RATIO == 2.0
+        mc = captured["mcts_config"]
+        assert mc.max_simulations == 200  # preset 2
+        assert mc.root_selection == "gumbel"
+        assert mc.fast_simulations == 16
+        assert mc.full_search_prob == 0.5
+        assert captured["model_config"].USE_TRANSFORMER is False
+
+    def test_full_search_prob_without_fast_sims_errors(self, monkeypatch):
+        self._capture(monkeypatch)
+        with pytest.raises(SystemExit):
+            cli.main(
+                ["train", "--full-search-prob", "0.5", "--no-tensorboard"]
+            )
+
     def test_defaults_leave_config_alone(self, monkeypatch):
         captured = self._capture(monkeypatch)
         assert cli.main(["train", "--run-name", "r"]) == 0

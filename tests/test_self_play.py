@@ -101,6 +101,30 @@ class TestBasics:
         assert engine.harvest().trainer_step_at_episode_start == v0 + 1
 
 
+class TestSharedCompile:
+    def test_streams_share_chunk_programs(self, world):
+        e1, tc = make_engine(world)
+        env, fe, net, mcts_cfg = world
+        e2 = type(e1)(
+            env, fe, net, mcts_cfg, tc, seed=99, share_compiled=e1
+        )
+        assert e2._chunk_fn is e1._chunk_fn
+        # Both streams advance independently through the shared program.
+        e1.play_chunk(2)
+        e2.play_chunk(2)
+        r1, r2 = e1.harvest(), e2.harvest()
+        assert r1.num_experiences >= 0 and r2.num_experiences >= 0
+
+    def test_mismatched_configs_rejected(self, world):
+        e1, tc = make_engine(world)
+        env, fe, net, mcts_cfg = world
+        other_tc = tc.model_copy(update={"SELF_PLAY_BATCH_SIZE": 8})
+        with pytest.raises(ValueError, match="identically-configured"):
+            type(e1)(
+                env, fe, net, mcts_cfg, other_tc, seed=1, share_compiled=e1
+            )
+
+
 class TestPlayoutCapRandomization:
     """KataGo-style PCR (config/mcts_config.py): fast moves carry
     policy weight 0; accounting reflects the sims actually run."""

@@ -193,6 +193,24 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    def test_multi_stream_producers(self, tmp_path, tiny_world_configs):
+        """NUM_SELF_PLAY_WORKERS=2 runs two independent rollout
+        streams into the shared queue (the reference's worker fan-out,
+        worker_manager.py:39-75, as producer threads)."""
+        c = build(
+            tmp_path, tiny_world_configs, run_name="multi_stream",
+            ASYNC_ROLLOUTS=True, NUM_SELF_PLAY_WORKERS=2,
+            MAX_TRAINING_STEPS=4,
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 4
+        assert loop.episodes_played >= 0
+        assert len(c.buffer) > 0
+        c.stats.close()
+        c.checkpoints.close()
+
     def test_replay_ratio_gate(self, tmp_path, tiny_world_configs):
         """The learner never consumes more than REPLAY_RATIO allows."""
         ratio = 0.5
