@@ -349,34 +349,42 @@ def cmd_eval(args: argparse.Namespace) -> int:
 
     env = TriangleEnv(env_cfg)
     extractor = get_feature_extractor(env, model_cfg)
-    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
 
-    source = "untrained"
-    if args.checkpoint or args.run_name:
-        trainer = Trainer(net, train_cfg)
-        if args.checkpoint:
-            persistence = PersistenceConfig(RUN_NAME="eval_tmp")
+    def restore_net(checkpoint: str | None, run_name: str | None):
+        """Fresh net, optionally restored from a checkpoint path or a
+        run's latest checkpoint. Returns (net, source-label)."""
+        n = NeuralNetwork(model_cfg, env_cfg, seed=0)
+        label = "untrained"
+        if checkpoint or run_name:
+            trainer = Trainer(n, train_cfg)
+            persistence = PersistenceConfig(
+                RUN_NAME=run_name or "eval_tmp"
+            )
             if args.root_dir:
                 persistence = persistence.model_copy(
                     update={"ROOT_DATA_DIR": args.root_dir}
                 )
             mgr = CheckpointManager(persistence)
-            loaded = mgr.restore_path(args.checkpoint, trainer.state)
-        else:
-            persistence = PersistenceConfig(RUN_NAME=args.run_name)
-            if args.root_dir:
-                persistence = persistence.model_copy(
-                    update={"ROOT_DATA_DIR": args.root_dir}
-                )
-            mgr = CheckpointManager(persistence)
-            loaded = mgr.restore(trainer.state)
-        if loaded.train_state is None:
-            print("No checkpoint found; evaluating the untrained net.")
-        else:
-            trainer.set_state(loaded.train_state)
-            trainer.sync_to_network()
-            source = f"step {loaded.global_step}"
+            loaded = (
+                mgr.restore_path(checkpoint, trainer.state)
+                if checkpoint
+                else mgr.restore(trainer.state)
+            )
+            if loaded.train_state is None:
+                print("No checkpoint found; evaluating the untrained net.")
+            else:
+                trainer.set_state(loaded.train_state)
+                trainer.sync_to_network()
+                label = f"step {loaded.global_step}"
+                if run_name and not checkpoint:
+                    # Only attribute to the run when the run's own
+                    # latest checkpoint was what we restored (an
+                    # explicit --checkpoint path wins the ternary and
+                    # may come from a different run).
+                    label = f"{run_name} {label}"
+        return n, label
 
+    net, source = restore_net(args.checkpoint, args.run_name)
     mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
     B = args.games
     rng = np.random.default_rng(args.seed)
@@ -399,12 +407,19 @@ def cmd_eval(args: argparse.Namespace) -> int:
             np.asarray(states.done),
         )
 
-    def mcts_policy(states, move):
-        out = mcts.search(
-            net.variables, states, jax.random.PRNGKey(7000 + move)
-        )
-        counts = np.asarray(out.visit_counts)
-        return np.where(counts.sum(axis=1) > 0, counts.argmax(axis=1), 0)
+    def make_mcts_policy(search, n):
+        def policy(states, move):
+            out = search.search(
+                n.variables, states, jax.random.PRNGKey(7000 + move)
+            )
+            counts = np.asarray(out.visit_counts)
+            return np.where(
+                counts.sum(axis=1) > 0, counts.argmax(axis=1), 0
+            )
+
+        return policy
+
+    mcts_policy = make_mcts_policy(mcts, net)
 
     def random_policy(states, move):
         masks = np.asarray(env.valid_mask_batch(states))
@@ -437,6 +452,26 @@ def cmd_eval(args: argparse.Namespace) -> int:
             float((diffs > 0).mean() + 0.5 * (diffs == 0).mean()), 3
         ),
     }
+
+    # Head-to-head: a second checkpoint plays the SAME paired hands.
+    if args.vs_checkpoint or args.vs_run:
+        net_b, source_b = restore_net(args.vs_checkpoint, args.vs_run)
+        mcts_b = BatchedMCTS(
+            env, extractor, net_b.model, mcts_cfg, net_b.support
+        )
+        b_scores, _, _ = play(make_mcts_policy(mcts_b, net_b))
+        h2h = scores - b_scores
+        report.update(
+            {
+                "vs_source": source_b,
+                "vs_mean_score": round(float(b_scores.mean()), 2),
+                "h2h_paired_mean_diff": round(float(h2h.mean()), 3),
+                "h2h_win_rate": round(
+                    float((h2h > 0).mean() + 0.5 * (h2h == 0).mean()), 3
+                ),
+            }
+        )
+
     print(_json.dumps(report))
     return 0
 
@@ -629,6 +664,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     ev.add_argument("--checkpoint", default=None, metavar="PATH")
     ev.add_argument("--run-name", default=None)
+    ev.add_argument(
+        "--vs-checkpoint",
+        default=None,
+        metavar="PATH",
+        help="Head-to-head opponent checkpoint (plays the same paired "
+        "hands).",
+    )
+    ev.add_argument(
+        "--vs-run",
+        default=None,
+        help="Head-to-head opponent: latest checkpoint of this run.",
+    )
     ev.add_argument("--root-dir", default=None)
     ev.add_argument("--games", type=int, default=64)
     ev.add_argument("--sims", type=int, default=64)

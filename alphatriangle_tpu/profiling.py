@@ -53,20 +53,26 @@ class PhaseTimers:
 
     def metrics(self) -> dict[str, float]:
         """Mean milliseconds per phase, for the stats pipeline."""
+        with self._lock:  # keys can be inserted by producer threads
+            totals = dict(self._total)
+            counts = dict(self._count)
         return {
-            f"Profile/{name}_ms": 1000.0 * self._total[name] / self._count[name]
-            for name in self._total
-            if self._count[name]
+            f"Profile/{name}_ms": 1000.0 * totals[name] / counts[name]
+            for name in totals
+            if counts[name]
         }
 
     def summary(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            totals = dict(self._total)
+            counts = dict(self._count)
         return {
             name: {
-                "total_seconds": self._total[name],
-                "count": self._count[name],
-                "mean_ms": 1000.0 * self._total[name] / max(self._count[name], 1),
+                "total_seconds": totals[name],
+                "count": counts[name],
+                "mean_ms": 1000.0 * totals[name] / max(counts[name], 1),
             }
-            for name in sorted(self._total)
+            for name in sorted(totals)
         }
 
     def dump(self, path: Path) -> None:

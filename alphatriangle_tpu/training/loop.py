@@ -527,6 +527,10 @@ class TrainingLoop:
     def _run_async(self) -> None:
         cfg = self.cfg
         harvests: "queue.Queue" = queue.Queue(maxsize=cfg.ROLLOUT_QUEUE_MAX)
+        # Materialize the shared chunk program's jit wrapper before the
+        # producer threads race the lru_cache: concurrent first misses
+        # may each build (and compile) their own wrapper.
+        self.c.self_play._chunk_fn(cfg.ROLLOUT_CHUNK_MOVES)
         producers = [
             threading.Thread(
                 target=self._producer_loop,

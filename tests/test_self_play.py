@@ -109,11 +109,15 @@ class TestSharedCompile:
             env, fe, net, mcts_cfg, tc, seed=99, share_compiled=e1
         )
         assert e2._chunk_fn is e1._chunk_fn
-        # Both streams advance independently through the shared program.
-        e1.play_chunk(2)
-        e2.play_chunk(2)
+        # Both streams advance independently through the shared program
+        # and BOTH produce experiences (6 moves > n_step=3 guarantees
+        # matured emissions per stream).
+        e1.play_chunk(6)
+        e2.play_chunk(6)
         r1, r2 = e1.harvest(), e2.harvest()
-        assert r1.num_experiences >= 0 and r2.num_experiences >= 0
+        assert r1.num_experiences > 0 and r2.num_experiences > 0
+        # Different seeds -> different games (not a shared-carry alias).
+        assert not np.array_equal(r1.grid, r2.grid)
 
     def test_mismatched_configs_rejected(self, world):
         e1, tc = make_engine(world)

@@ -206,7 +206,7 @@ class TestAsyncLoop:
         status = loop.run()
         assert status == LoopStatus.COMPLETED
         assert loop.global_step == 4
-        assert loop.episodes_played >= 0
+        assert loop.experiences_added > 0
         assert len(c.buffer) > 0
         c.stats.close()
         c.checkpoints.close()
