@@ -75,6 +75,10 @@ def build():
             "gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else "puct"
         ),
         gumbel_m=8,
+        # LEARN_PCR=1 A/Bs playout cap randomization: 4-sim fast
+        # searches for 75% of moves (policy targets only from the
+        # 16-sim full searches).
+        fast_simulations=(4 if os.environ.get("LEARN_PCR") == "1" else None),
     )
     train_cfg = TrainConfig(
         SELF_PLAY_BATCH_SIZE=32,
@@ -220,7 +224,14 @@ def main() -> None:
         results["greedy_final"] = eval_points[-1][1]
         results["improved"] = eval_points[-1][1] > eval_points[0][1]
     suffix = "_gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else ""
-    results["root_selection"] = "gumbel" if suffix else "puct"
+    if os.environ.get("LEARN_PCR") == "1":
+        suffix += "_pcr"
+    results["root_selection"] = (
+        "gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else "puct"
+    )
+    results["playout_cap_randomization"] = (
+        os.environ.get("LEARN_PCR") == "1"
+    )
     out_path = Path(__file__).parent / f"learning_curve_results{suffix}.json"
     out_path.write_text(json.dumps(results, indent=2))
     print(json.dumps(results))
