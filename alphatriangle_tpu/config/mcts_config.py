@@ -52,6 +52,12 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # fast budget while policy targets keep full-search quality.
     fast_simulations: int | None = Field(default=None, gt=0)
     full_search_prob: float = Field(default=0.25, gt=0, le=1.0)
+    # KataGo-faithful (default): fast-search positions produce NO
+    # training rows at all — they only advance the game cheaply.
+    # True keeps them as value-only rows (policy weight 0); measured
+    # on the tiny-board harness this degrades the value head (their
+    # n-step bootstraps come from the noisy fast-search roots).
+    pcr_record_fast_rows: bool = Field(default=False)
     # --- Gumbel root search (Danihelka et al. 2022 / mctx; beyond-
     # reference, mcts/gumbel.py). "gumbel": root actions are explored
     # by sampled Gumbel noise + sequential halving across waves, the

@@ -39,9 +39,20 @@ from .search import BatchedMCTS, SearchOutput
 class GumbelMCTS(BatchedMCTS):
     """Wave-parallel search with Gumbel sequential-halving root."""
 
-    def __init__(self, env, extractor, model, config: MCTSConfig, support):
+    def __init__(
+        self,
+        env,
+        extractor,
+        model,
+        config: MCTSConfig,
+        support,
+        exploit: bool = False,
+    ):
         # Dirichlet root noise is PUCT's exploration mechanism; Gumbel
-        # sampling replaces it entirely (paper §3).
+        # sampling replaces it entirely (paper §3). `exploit` zeroes
+        # the Gumbel sample too (deterministic logits + sigma(q)
+        # halving/argmax) — playout-cap fast searches must play the
+        # best cheap move, not explore.
         super().__init__(
             env,
             extractor,
@@ -52,6 +63,7 @@ class GumbelMCTS(BatchedMCTS):
         self.m_candidates = config.gumbel_m
         self.c_visit = config.gumbel_c_visit
         self.c_scale = config.gumbel_c_scale
+        self.exploit = exploit
 
     # --- scoring helpers --------------------------------------------------
 
@@ -82,7 +94,11 @@ class GumbelMCTS(BatchedMCTS):
         logits = jnp.where(
             valid, jnp.log(jnp.maximum(tree.prior[:, 0, :], 1e-12)), -jnp.inf
         )
-        g = jax.random.gumbel(gumbel_rng, (batch, a))
+        g = (
+            jnp.zeros((batch, a))
+            if self.exploit
+            else jax.random.gumbel(gumbel_rng, (batch, a))
+        )
         base_score = jnp.where(valid, g + logits, -jnp.inf)  # (B, A)
 
         # Initial candidates: top-m by g + logits among valid actions.

@@ -114,8 +114,16 @@ class SelfPlayEngine:
                     "dirichlet_epsilon": 0.0,
                 }
             )
+            fast_kw = (
+                # Fast Gumbel searches must exploit, not explore: the
+                # PUCT path gets this via temperature 0 at selection,
+                # the Gumbel path by zeroing the root Gumbel sample.
+                {"exploit": True}
+                if search_cls is GumbelMCTS
+                else {}
+            )
             self.mcts_fast = search_cls(
-                env, extractor, net.model, fast_cfg, net.support
+                env, extractor, net.model, fast_cfg, net.support, **fast_kw
             )
         self.config = train_config
         self.mcts_config = mcts_config
@@ -240,6 +248,16 @@ class SelfPlayEngine:
         # 3. Mature the slot added n moves ago: bootstrap with this
         # search's root value (the MCTS estimate of V(s_t) = V(s_{t-n+n})).
         mat_mask = carry.pend_active[:, w]
+        if (
+            self.mcts_fast is not None
+            and not self.mcts_config.pcr_record_fast_rows
+        ):
+            # KataGo-faithful playout cap randomization: positions
+            # searched cheaply never become training rows (their
+            # targets — noisy fast-search policy AND the n-step value
+            # whose bootstrap is a fast root — are below training
+            # quality; measured in docs/MCTS_DESIGN.md §e).
+            mat_mask = mat_mask & (carry.pend_pweight[:, w] > 0.5)
         mat = {
             "grid": carry.pend_grid[:, w],
             "other": carry.pend_other[:, w],
@@ -259,6 +277,15 @@ class SelfPlayEngine:
             actions = out.selected_action
         else:
             temps = self._temperatures(states.step_count)
+            if self.mcts_fast is not None:
+                # Playout-cap fast moves play GREEDILY (KataGo §3.1):
+                # they exist to advance the game with the best cheap
+                # decision, not to explore — temperature on a handful
+                # of visits is near-uniform noise, and training on the
+                # resulting near-random trajectories degrades the value
+                # head (measured: greedy eval 7.53 -> 6.82 before this
+                # guard). Exploration stays on full-search moves.
+                temps = jnp.where(is_full, temps, 0.0)
             actions = select_action_from_visits(
                 out.visit_counts, temps, k_select
             )
@@ -292,6 +319,11 @@ class SelfPlayEngine:
         truncated = (~dones) & (step_counts >= self.config.MAX_EPISODE_MOVES)
         ending = dones | truncated
         flush_mask = pend_active & ending[:, None]
+        if (
+            self.mcts_fast is not None
+            and not self.mcts_config.pcr_record_fast_rows
+        ):
+            flush_mask = flush_mask & (pend_pweight > 0.5)
         flush = {
             "grid": pend_grid,
             "other": pend_other,

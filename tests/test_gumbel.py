@@ -118,6 +118,36 @@ class TestGumbelSearch:
             if not done[b]:
                 assert visits[b, sel[b]] > 0, (b, sel[b], visits[b])
 
+    def test_exploit_mode_is_deterministic_across_rng(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        """exploit=True (PCR fast searches) zeroes the root Gumbel
+        sample: with the descent wave noise also off, the whole search
+        is deterministic — selection must not depend on the search rng.
+        (With wave noise on, q estimates still vary benignly; the
+        contract is no root EXPLORATION noise.)"""
+        env = TriangleEnv(tiny_env_config)
+        fe = get_feature_extractor(env, tiny_model_config)
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        cfg = type(tiny_mcts_config)(
+            **{
+                **tiny_mcts_config.model_dump(),
+                "root_selection": "gumbel",
+                "gumbel_m": 4,
+                "wave_noise_scale": 0.0,
+            }
+        )
+        mcts = GumbelMCTS(
+            env, fe, net.model, cfg, net.support, exploit=True
+        )
+        keys = jax.random.split(jax.random.PRNGKey(2), B)
+        states = env.reset_batch(keys)
+        out1 = mcts.search(net.variables, states, jax.random.PRNGKey(1))
+        out2 = mcts.search(net.variables, states, jax.random.PRNGKey(999))
+        np.testing.assert_array_equal(
+            np.asarray(out1.selected_action), np.asarray(out2.selected_action)
+        )
+
     def test_no_dirichlet_noise_applied(self, gumbel_world):
         """GumbelMCTS zeroes dirichlet_epsilon internally."""
         *_, mcts = gumbel_world

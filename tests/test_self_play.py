@@ -133,7 +133,7 @@ class TestPlayoutCapRandomization:
     """KataGo-style PCR (config/mcts_config.py): fast moves carry
     policy weight 0; accounting reflects the sims actually run."""
 
-    def make_pcr_engine(self, world, prob=0.5):
+    def make_pcr_engine(self, world, prob=0.5, record_fast=False):
         env, fe, net, mcts_cfg = world
         pcr_cfg = type(mcts_cfg)(
             **{
@@ -142,12 +142,28 @@ class TestPlayoutCapRandomization:
                     2, mcts_cfg.max_simulations // 4
                 ),
                 "full_search_prob": prob,
+                "pcr_record_fast_rows": record_fast,
             }
         )
         return make_engine((env, fe, net, pcr_cfg))
 
-    def test_policy_weights_mark_fast_moves(self, world):
+    def test_default_drops_fast_rows(self, world):
+        """KataGo-faithful default: cheap-search positions advance the
+        game but never become training rows."""
         engine, _ = self.make_pcr_engine(world, prob=0.5)
+        engine.play_chunk(24)
+        trace = engine.last_trace
+        fulls = np.asarray(trace["is_full"])
+        assert 0 < fulls.sum() < fulls.size  # both kinds of move ran
+        result = engine.harvest()
+        assert result.num_experiences > 0
+        # Everything that reached replay came from a full search.
+        assert np.all(result.policy_weight == 1.0)
+
+    def test_policy_weights_mark_fast_moves(self, world):
+        engine, _ = self.make_pcr_engine(
+            world, prob=0.5, record_fast=True
+        )
         engine.play_chunk(24)
         trace = engine.last_trace
         assert trace is not None and "is_full" in trace
