@@ -283,25 +283,48 @@ def run_bench(smoke: bool, seconds: float) -> dict:
 
     # --- overlapped producer/consumer (combined rates) ------------------
     # The phases above run each side alone; this measures both at once
-    # (the training loop's ASYNC_ROLLOUTS topology): a producer thread
-    # drives self-play chunks while the main thread trains.
+    # (the training loop's ASYNC_ROLLOUTS topology): producer thread(s)
+    # drive self-play chunks while the main thread trains. BENCH_WORKERS
+    # > 1 measures the multi-stream topology (NUM_SELF_PLAY_WORKERS).
     import threading
 
     overlap_seconds = 5.0 if smoke else min(40.0, seconds)
-    engine.harvest()  # reset counters
+    n_streams = max(1, int(os.environ.get("BENCH_WORKERS", "1")))
+    engines = [engine]
+    for i in range(1, n_streams):
+        engines.append(
+            SelfPlayEngine(
+                env,
+                extractor,
+                net,
+                mcts_cfg,
+                train_cfg,
+                seed=100 + i,
+                share_compiled=engine,
+            )
+        )
+    for e in engines:
+        e.harvest()  # reset counters
     stop = threading.Event()
-    produced = {"moves": 0, "error": None}
+    produced = {"moves": 0, "errors": []}
+    lock = threading.Lock()
 
-    def producer() -> None:
+    def producer(e) -> None:
         try:
             while not stop.is_set():
-                engine.play_chunk()
-                produced["moves"] += chunk
+                e.play_chunk(chunk)
+                with lock:
+                    produced["moves"] += chunk
         except Exception as exc:  # surface, don't hang the bench
-            produced["error"] = f"{type(exc).__name__}: {exc}"
+            with lock:
+                produced["errors"].append(f"{type(exc).__name__}: {exc}")
 
-    th = threading.Thread(target=producer, daemon=True)
-    th.start()
+    threads = [
+        threading.Thread(target=producer, args=(e,), daemon=True)
+        for e in engines
+    ]
+    for th in threads:
+        th.start()
     t0 = time.time()
     o_steps = 0
     while time.time() - t0 < overlap_seconds:
@@ -309,21 +332,21 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         o_steps += fused_k
     jax.block_until_ready(trainer.state.params)
     stop.set()
-    th.join(timeout=120)
+    for th in threads:
+        th.join(timeout=120)
     o_elapsed = time.time() - t0
-    o_result = engine.harvest()
+    o_episodes = sum(e.harvest().num_episodes for e in engines)
     overlapped = {
         "seconds": round(o_elapsed, 1),
-        "games_per_hour": round(
-            o_result.num_episodes / o_elapsed * 3600.0, 1
-        ),
+        "streams": n_streams,
+        "games_per_hour": round(o_episodes / o_elapsed * 3600.0, 1),
         "moves_per_sec": round(
             produced["moves"] * sp_batch / o_elapsed, 1
         ),
         "learner_steps_per_sec": round(o_steps / o_elapsed, 2),
     }
-    if produced["error"]:
-        overlapped["producer_error"] = produced["error"]
+    if produced["errors"]:
+        overlapped["producer_errors"] = produced["errors"]
     log(f"bench: overlapped {overlapped}")
 
     north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)

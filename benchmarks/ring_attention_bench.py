@@ -63,6 +63,8 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     grad_ring = jax.jit(jax.grad(lambda q, k, v: (ring(q, k, v) ** 2).sum()))
+    ring_jit = jax.jit(ring)
+    dense_jit = jax.jit(dense_attention)
 
     for log2 in range(9, max_log2 + 1):
         s = 1 << log2
@@ -80,15 +82,13 @@ def main() -> None:
                 (s // 8) * (s // 8) * 4 / 2**20, 2
             ),
         }
-        row["ring_fwd_s"] = round(timed(jax.jit(ring), q, k, v), 3)
+        row["ring_fwd_s"] = round(timed(ring_jit, q, k, v), 3)
         row["ring_grad_s"] = round(timed(grad_ring, q, k, v), 3)
         # Dense comparison only while the score matrix is sane on CPU.
         if s <= 4096:
-            row["dense_fwd_s"] = round(
-                timed(jax.jit(dense_attention), q, k, v), 3
-            )
-            out_r = jax.jit(ring)(q, k, v)
-            out_d = dense_attention(q, k, v)
+            row["dense_fwd_s"] = round(timed(dense_jit, q, k, v), 3)
+            out_r = ring_jit(q, k, v)
+            out_d = dense_jit(q, k, v)
             np.testing.assert_allclose(
                 np.asarray(out_r), np.asarray(out_d), rtol=3e-4, atol=3e-4
             )
