@@ -211,6 +211,49 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    def test_all_features_compose(self, tmp_path, tiny_world_configs):
+        """Cross-feature integration: Gumbel root search + playout cap
+        randomization + fused learner groups + overlapped multi-stream
+        + PER, all in one run. Guards against pairwise-tested features
+        breaking in combination."""
+        env_cfg, model_cfg, mcts_cfg = tiny_world_configs
+        pcr_gumbel_cfg = type(mcts_cfg)(
+            **{
+                **mcts_cfg.model_dump(),
+                "root_selection": "gumbel",
+                "gumbel_m": 4,
+                "fast_simulations": 2,
+                "full_search_prob": 0.5,
+            }
+        )
+        tc = make_train_cfg("combo_run", str(tmp_path),
+            ASYNC_ROLLOUTS=True, NUM_SELF_PLAY_WORKERS=2,
+            FUSED_LEARNER_STEPS=2, MAX_TRAINING_STEPS=4,
+        )
+        pc = PersistenceConfig(
+            ROOT_DATA_DIR=str(tmp_path), RUN_NAME="combo_run"
+        )
+        c = setup_training_components(
+            train_config=tc,
+            env_config=env_cfg,
+            model_config=model_cfg,
+            mcts_config=pcr_gumbel_cfg,
+            persistence_config=pc,
+            use_tensorboard=False,
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 4
+        assert loop.experiences_added > 0
+        # PCR default drops fast rows: everything in the buffer is
+        # policy-trainable.
+        sample = c.buffer.sample(4, current_train_step=4)
+        assert sample is not None
+        assert np.all(sample["batch"]["policy_weight"] == 1.0)
+        c.stats.close()
+        c.checkpoints.close()
+
     def test_replay_ratio_gate(self, tmp_path, tiny_world_configs):
         """The learner never consumes more than REPLAY_RATIO allows."""
         ratio = 0.5
