@@ -123,7 +123,11 @@ class TrainConfig(BaseModel):
     TEMPERATURE_FINAL: float = Field(default=0.1, ge=0)
     TEMPERATURE_ANNEAL_MOVES: int = Field(default=30, ge=1)
 
-    # --- Device / compile (parity surface; JAX jits everything anyway) ---
+    # --- Device / compile ---
+    # DEVICE is enforced at startup (utils.helpers.enforce_platform).
+    # WORKER_DEVICE and COMPILE_MODEL are config-surface parity stubs:
+    # self-play shares the learner's device by design (there are no
+    # separate worker processes), and JAX jits everything regardless.
     DEVICE: Literal["auto", "tpu", "cpu"] = Field(default="auto")
     WORKER_DEVICE: Literal["auto", "tpu", "cpu"] = Field(default="auto")
     COMPILE_MODEL: bool = Field(default=True)

@@ -123,6 +123,63 @@ def main() -> None:
     mgr.save(1, trainer.state)
     mgr.wait_until_finished()
     print(f"PRIMARY={int(is_primary())}", flush=True)
+
+    # Phase 2: sequence parallelism under jax.distributed — ring
+    # attention over a (dp=2, mdl=1, sp=2) global mesh whose sp axis
+    # GENUINELY crosses the process boundary: jax.devices() orders
+    # [p0d0, p0d1, p1d0, p1d1], and a plain reshape would pair sp
+    # within each process (leaving only the grad-reduce cross-host).
+    # Interleave so each sp pair is (p0 device, p1 device) and the
+    # ring ppermute itself rides the inter-process link.
+    from alphatriangle_tpu.parallel import make_sp_attention
+
+    devs = jax.devices()
+    assert [d.process_index for d in devs] == [0, 0, 1, 1], devs
+    sp_mesh = MeshConfig(DP_SIZE=2, SP_SIZE=2).build_mesh(
+        devices=[devs[0], devs[2], devs[1], devs[3]]
+    )
+    sp_axis_procs = {
+        frozenset(d.process_index for d in row)
+        for row in sp_mesh.devices.reshape(2, 2)
+    }
+    assert sp_axis_procs == {frozenset({0, 1})}, sp_mesh.devices
+    sp_model_cfg = model_cfg.model_copy(
+        update={
+            "USE_TRANSFORMER": True,
+            "TRANSFORMER_DIM": 8,
+            "TRANSFORMER_HEADS": 2,
+            "TRANSFORMER_LAYERS": 1,
+            "TRANSFORMER_FC_DIM": 16,
+        }
+    )
+    sp_net = NeuralNetwork(
+        sp_model_cfg,
+        env_cfg,
+        seed=0,
+        attention_fn=make_sp_attention(sp_mesh, kind="ring"),
+    )
+    sp_trainer = Trainer(sp_net, train_cfg, mesh=sp_mesh)
+    # With sp crossing processes, every dp batch shard is replicated
+    # onto devices of BOTH processes — so both must supply identical
+    # local data (make_array_from_process_local_data fills replicas
+    # from each process's own buffer). Shared seed, not 100+pid.
+    rng2 = np.random.default_rng(4242)
+    policy2 = rng2.random((b, env_cfg.action_dim)).astype(np.float32)
+    policy2 /= policy2.sum(axis=1, keepdims=True)
+    sp_batch = {
+        "grid": rng2.integers(
+            -1, 2, size=(b, 1, env_cfg.ROWS, env_cfg.COLS)
+        ).astype(np.float32),
+        "other_features": rng2.random(
+            (b, model_cfg.OTHER_NN_INPUT_FEATURES_DIM)
+        ).astype(np.float32),
+        "policy_target": policy2,
+        "value_target": rng2.uniform(-5, 5, b).astype(np.float32),
+        "weights": np.ones(b, np.float32),
+    }
+    sp_metrics, _ = sp_trainer.train_step(sp_batch)
+    assert np.isfinite(sp_metrics["total_loss"]), sp_metrics
+    print(f"SP_LOSS={sp_metrics['total_loss']:.6f}", flush=True)
     print("DIST_OK", flush=True)
 
 

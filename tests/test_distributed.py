@@ -95,6 +95,8 @@ def test_two_process_train_step(tmp_path):
     # Replicated state + global loss agree across processes.
     assert field(outs[0], "LOSS") == field(outs[1], "LOSS")
     assert field(outs[0], "PARAM_SUM") == field(outs[1], "PARAM_SUM")
+    # Ring attention over the cross-process sp axis agrees too.
+    assert field(outs[0], "SP_LOSS") == field(outs[1], "SP_LOSS")
     assert field(outs[0], "PRIMARY") == "1"
     assert field(outs[1], "PRIMARY") == "0"
 

@@ -226,20 +226,14 @@ class TestAsyncLoop:
                 "full_search_prob": 0.5,
             }
         )
-        tc = make_train_cfg("combo_run", str(tmp_path),
-            ASYNC_ROLLOUTS=True, NUM_SELF_PLAY_WORKERS=2,
-            FUSED_LEARNER_STEPS=2, MAX_TRAINING_STEPS=4,
-        )
-        pc = PersistenceConfig(
-            ROOT_DATA_DIR=str(tmp_path), RUN_NAME="combo_run"
-        )
-        c = setup_training_components(
-            train_config=tc,
-            env_config=env_cfg,
-            model_config=model_cfg,
-            mcts_config=pcr_gumbel_cfg,
-            persistence_config=pc,
-            use_tensorboard=False,
+        c = build(
+            tmp_path,
+            (env_cfg, model_cfg, pcr_gumbel_cfg),
+            run_name="combo_run",
+            ASYNC_ROLLOUTS=True,
+            NUM_SELF_PLAY_WORKERS=2,
+            FUSED_LEARNER_STEPS=2,
+            MAX_TRAINING_STEPS=4,
         )
         loop = TrainingLoop(c)
         status = loop.run()
