@@ -634,6 +634,102 @@ def cmd_play(args: argparse.Namespace) -> int:
         print(f"reward {reward:+.1f}")
 
 
+def cmd_tune(args: argparse.Namespace) -> int:
+    """On-hardware self-play shape autotuner.
+
+    Sweeps (SELF_PLAY_BATCH_SIZE, ROLLOUT_CHUNK_MOVES) cells on the
+    actual backend, measuring moves/s and games/hour per cell, and
+    recommends the best. TPU throughput is shape-sensitive (MXU tiling,
+    dispatch amortization) in ways no static heuristic predicts — this
+    replaces guesswork when bringing the framework up on new hardware.
+    No reference equivalent (its worker count is a CPU-core heuristic,
+    `alphatriangle/training/setup.py:106-151`).
+    """
+    import json as _json
+    import time
+
+    from .utils.helpers import enforce_platform
+
+    enforce_platform(args.device or "auto")
+
+    import jax
+
+    from .config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+    from .env.engine import TriangleEnv
+    from .features.core import get_feature_extractor
+    from .nn.network import NeuralNetwork
+    from .rl import SelfPlayEngine
+
+    backend = jax.default_backend()
+    env_cfg = EnvConfig()
+    model_cfg = ModelConfig(
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+
+    batches = [int(b) for b in args.batches.split(",")]
+    chunks = [int(c) for c in args.chunks.split(",")]
+    print(
+        f"tune: backend={backend} sims={args.sims} "
+        f"cells={len(batches) * len(chunks)} "
+        f"({args.seconds_per_cell:.0f}s each + compile)"
+    )
+    rows = []
+    for b in batches:
+        for chunk in chunks:
+            train_cfg = TrainConfig(
+                SELF_PLAY_BATCH_SIZE=b,
+                ROLLOUT_CHUNK_MOVES=chunk,
+                RUN_NAME="tune",
+            )
+            engine = SelfPlayEngine(
+                env, extractor, net, mcts_cfg, train_cfg, seed=0
+            )
+            t0 = time.time()
+            engine.play_chunk(chunk)
+            compile_s = time.time() - t0
+            engine.harvest()
+            t0 = time.time()
+            moves = 0
+            while time.time() - t0 < args.seconds_per_cell:
+                engine.play_chunk(chunk)
+                moves += chunk
+            elapsed = time.time() - t0
+            episodes = engine.harvest().num_episodes
+            row = {
+                "batch": b,
+                "chunk": chunk,
+                "moves_per_sec": round(moves * b / elapsed, 1),
+                "games_per_hour": round(episodes / elapsed * 3600.0, 1),
+                "compile_s": round(compile_s, 1),
+            }
+            rows.append(row)
+            print(_json.dumps(row), flush=True)
+            del engine
+    # Short windows can complete zero episodes in every cell;
+    # moves/s breaks the tie.
+    best = max(
+        rows, key=lambda r: (r["games_per_hour"], r["moves_per_sec"])
+    )
+    print(
+        f"tune: best games/hour at --self-play-batch {best['batch']} "
+        f"--rollout-chunk {best['chunk']} "
+        f"({best['games_per_hour']:.0f} games/h, "
+        f"{best['moves_per_sec']:.0f} moves/s)"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="alphatriangle-tpu",
@@ -685,6 +781,23 @@ def main(argv: list[str] | None = None) -> int:
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
 
+    tune = sub.add_parser(
+        "tune",
+        help="Sweep self-play batch/chunk shapes on this hardware and "
+        "recommend the fastest.",
+    )
+    tune.add_argument(
+        "--batches", default="256,512,1024", help="Comma-separated lane counts."
+    )
+    tune.add_argument(
+        "--chunks", default="8,16", help="Comma-separated chunk lengths."
+    )
+    tune.add_argument("--sims", type=int, default=64)
+    tune.add_argument("--seconds-per-cell", type=float, default=20.0)
+    tune.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+
     play = sub.add_parser(
         "play", help="Interactive text play on the default board."
     )
@@ -708,6 +821,7 @@ def main(argv: list[str] | None = None) -> int:
         "analyze": cmd_analyze,
         "eval": cmd_eval,
         "play": cmd_play,
+        "tune": cmd_tune,
     }
     return handlers[args.command](args)
 

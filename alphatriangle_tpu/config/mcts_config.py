@@ -68,8 +68,12 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # Max root candidates considered by sequential halving.
     gumbel_m: int = Field(default=16, gt=1)
     # sigma(q) = (c_visit + max_visits) * c_scale * q   (paper Eq. 8).
+    # c_scale default follows the paper's 1.0 (mctx ships 0.1): on the
+    # tiny-board learning harness 0.1 plateaued the trained net at
+    # 7.65 while 0.5/1.0 reach ~7.75 (docs/MCTS_DESIGN.md §d sweep) —
+    # too-small sigma keeps completed-Q targets glued to the prior.
     gumbel_c_visit: float = Field(default=50.0, ge=0)
-    gumbel_c_scale: float = Field(default=0.1, gt=0)
+    gumbel_c_scale: float = Field(default=1.0, gt=0)
 
     @model_validator(mode="after")
     def _check_fast(self) -> "AlphaTriangleMCTSConfig":

@@ -75,6 +75,9 @@ def build():
             "gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else "puct"
         ),
         gumbel_m=8,
+        # Follows the config default (paper c_scale=1.0); override to
+        # reproduce the sweep rows in docs/MCTS_DESIGN.md §d.
+        gumbel_c_scale=float(os.environ.get("LEARN_GUMBEL_CSCALE", "1.0")),
         # LEARN_PCR=1 A/Bs playout cap randomization: 4-sim fast
         # searches for 75% of moves (policy targets only from the
         # 16-sim full searches).
@@ -226,6 +229,12 @@ def main() -> None:
     suffix = "_gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else ""
     if os.environ.get("LEARN_PCR") == "1":
         suffix += "_pcr"
+    if suffix.startswith("_gumbel"):
+        results["gumbel_c_scale"] = float(
+            os.environ.get("LEARN_GUMBEL_CSCALE", "1.0")
+        )
+        if os.environ.get("LEARN_GUMBEL_CSCALE"):
+            suffix += f"_cs{os.environ['LEARN_GUMBEL_CSCALE']}"
     results["root_selection"] = (
         "gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else "puct"
     )
