@@ -101,6 +101,21 @@ class TestBasics:
         assert engine.harvest().trainer_step_at_episode_start == v0 + 1
 
 
+def make_pcr_engine(world, prob=0.5, record_fast=False):
+    """Engine with playout cap randomization on (shared by the PCR
+    behavior tests and the n-step deque cross-check)."""
+    env, fe, net, mcts_cfg = world
+    pcr_cfg = type(mcts_cfg)(
+        **{
+            **mcts_cfg.model_dump(),
+            "fast_simulations": max(2, mcts_cfg.max_simulations // 4),
+            "full_search_prob": prob,
+            "pcr_record_fast_rows": record_fast,
+        }
+    )
+    return make_engine((env, fe, net, pcr_cfg))
+
+
 class TestSharedCompile:
     def test_streams_share_chunk_programs(self, world):
         e1, tc = make_engine(world)
@@ -133,24 +148,11 @@ class TestPlayoutCapRandomization:
     """KataGo-style PCR (config/mcts_config.py): fast moves carry
     policy weight 0; accounting reflects the sims actually run."""
 
-    def make_pcr_engine(self, world, prob=0.5, record_fast=False):
-        env, fe, net, mcts_cfg = world
-        pcr_cfg = type(mcts_cfg)(
-            **{
-                **mcts_cfg.model_dump(),
-                "fast_simulations": max(
-                    2, mcts_cfg.max_simulations // 4
-                ),
-                "full_search_prob": prob,
-                "pcr_record_fast_rows": record_fast,
-            }
-        )
-        return make_engine((env, fe, net, pcr_cfg))
 
     def test_default_drops_fast_rows(self, world):
         """KataGo-faithful default: cheap-search positions advance the
         game but never become training rows."""
-        engine, _ = self.make_pcr_engine(world, prob=0.5)
+        engine, _ = make_pcr_engine(world, prob=0.5)
         engine.play_chunk(24)
         trace = engine.last_trace
         fulls = np.asarray(trace["is_full"])
@@ -161,7 +163,7 @@ class TestPlayoutCapRandomization:
         assert np.all(result.policy_weight == 1.0)
 
     def test_policy_weights_mark_fast_moves(self, world):
-        engine, _ = self.make_pcr_engine(
+        engine, _ = make_pcr_engine(
             world, prob=0.5, record_fast=True
         )
         engine.play_chunk(24)
@@ -176,7 +178,7 @@ class TestPlayoutCapRandomization:
         assert 0 < pw.sum() < pw.size  # both kinds reached the replay
 
     def test_sims_accounting_matches_trace(self, world):
-        engine, _ = self.make_pcr_engine(world, prob=0.5)
+        engine, _ = make_pcr_engine(world, prob=0.5)
         engine.play_chunk(10)
         trace = engine.last_trace
         expected = int(np.asarray(trace["sims"]).sum()) * engine.batch_size
@@ -192,7 +194,7 @@ class TestPlayoutCapRandomization:
         assert np.all(result.policy_weight == 1.0)
 
     def test_buffer_roundtrip_preserves_weights(self, world):
-        engine, tc = self.make_pcr_engine(world, prob=0.5)
+        engine, tc = make_pcr_engine(world, prob=0.5)
         result = engine.play_moves(24)
         buf = ExperienceBuffer(tc, action_dim=result.policy_target.shape[1])
         buf.add_dense(
@@ -247,6 +249,56 @@ class TestNStepMath:
                     item[1] *= gamma
                 if mv["ending"][b]:
                     expected.extend(i[0] for i in pending[b])
+                    pending[b] = []
+
+        got = np.sort(result.value_target)
+        want = np.sort(np.asarray(expected, np.float32))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_window_matches_reference_deque_under_pcr(self, world):
+        """Same deque cross-check with playout cap randomization: items
+        added on fast-search moves are never emitted (dropped at
+        maturation AND at episode flush), but their rewards still fold
+        into neighbours' returns and maturation bootstraps use whatever
+        search ran n moves later."""
+        env, fe, net, mcts_cfg = world
+        pcr_cfg = type(mcts_cfg)(
+            **{
+                **mcts_cfg.model_dump(),
+                "fast_simulations": max(2, mcts_cfg.max_simulations // 4),
+                "full_search_prob": 0.5,
+            }
+        )
+        engine, tc = make_engine((env, fe, net, pcr_cfg))
+        n, gamma = tc.N_STEP_RETURNS, tc.GAMMA
+        B = engine.batch_size
+
+        M = 20
+        result = engine.play_moves(M)
+        tr = engine.last_trace
+        fulls = np.asarray(tr["is_full"])  # (M,) per lockstep move
+        assert 0 < fulls.sum() < M  # both kinds occurred
+
+        expected: list[float] = []
+        pending: list[list[list[float]]] = [[] for _ in range(B)]
+        for t in range(M):
+            rv = tr["root_value"][t]
+            rew = tr["reward"][t]
+            end = tr["ending"][t]
+            for b in range(B):
+                for item in pending[b]:
+                    if t - item[2] == n and item[3]:  # full-move rows only
+                        expected.append(item[0] + item[1] * rv[b])
+                pending[b] = [i for i in pending[b] if t - i[2] < n]
+                pending[b].append([0.0, 1.0, t, bool(fulls[t])])
+                for item in pending[b]:
+                    item[0] += item[1] * rew[b]
+                    item[1] *= gamma
+                if end[b]:
+                    expected.extend(
+                        i[0] for i in pending[b] if i[3]
+                    )
                     pending[b] = []
 
         got = np.sort(result.value_target)
