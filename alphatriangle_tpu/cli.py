@@ -253,8 +253,12 @@ def cmd_train(args: argparse.Namespace) -> int:
     )
 
 
-def _launch_ui(tool: str, argv: list[str]) -> int:
-    """Run a dashboard tool in the foreground (reference `cli.py:85-137`)."""
+def _launch_ui(tool: str, argv: list[str], module: str | None = None) -> int:
+    """Run a dashboard tool in the foreground (reference `cli.py:85-137`).
+
+    `module`: the runnable module when it differs from the import name
+    (tensorboard's entry point is tensorboard.main, not the package).
+    """
     try:
         __import__(tool)
     except ImportError:
@@ -264,7 +268,7 @@ def _launch_ui(tool: str, argv: list[str]) -> int:
             file=sys.stderr,
         )
         return 1
-    cmd = [sys.executable, "-m", tool, *argv]
+    cmd = [sys.executable, "-m", module or tool, *argv]
     print(f"Launching: {' '.join(cmd)} (Ctrl-C to stop)")
     try:
         return subprocess.call(cmd)
@@ -277,7 +281,9 @@ def cmd_tb(args: argparse.Namespace) -> int:
 
     root = args.root_dir or PersistenceConfig().ROOT_DATA_DIR
     return _launch_ui(
-        "tensorboard", ["--logdir", root, "--port", str(args.port)]
+        "tensorboard",
+        ["--logdir", root, "--port", str(args.port)],
+        module="tensorboard.main",
     )
 
 
