@@ -390,8 +390,19 @@ def cmd_eval(args: argparse.Namespace) -> int:
                     label = f"{run_name} {label}"
         return n, label
 
+    def build_search(n):
+        if args.gumbel:
+            # Gumbel-aware evaluation: exploit mode (no root Gumbel
+            # sample) — deterministic argmax of logits + sigma(q).
+            from .mcts import GumbelMCTS
+
+            return GumbelMCTS(
+                env, extractor, n.model, mcts_cfg, n.support, exploit=True
+            )
+        return BatchedMCTS(env, extractor, n.model, mcts_cfg, n.support)
+
     net, source = restore_net(args.checkpoint, args.run_name)
-    mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
+    mcts = build_search(net)
     B = args.games
     rng = np.random.default_rng(args.seed)
 
@@ -418,6 +429,8 @@ def cmd_eval(args: argparse.Namespace) -> int:
             out = search.search(
                 n.variables, states, jax.random.PRNGKey(7000 + move)
             )
+            if args.gumbel:
+                return np.maximum(np.asarray(out.selected_action), 0)
             counts = np.asarray(out.visit_counts)
             return np.where(
                 counts.sum(axis=1) > 0, counts.argmax(axis=1), 0
@@ -462,9 +475,7 @@ def cmd_eval(args: argparse.Namespace) -> int:
     # Head-to-head: a second checkpoint plays the SAME paired hands.
     if args.vs_checkpoint or args.vs_run:
         net_b, source_b = restore_net(args.vs_checkpoint, args.vs_run)
-        mcts_b = BatchedMCTS(
-            env, extractor, net_b.model, mcts_cfg, net_b.support
-        )
+        mcts_b = build_search(net_b)
         b_scores, _, _ = play(make_mcts_policy(mcts_b, net_b))
         h2h = scores - b_scores
         report.update(
@@ -783,6 +794,12 @@ def main(argv: list[str] | None = None) -> int:
     ev.add_argument("--sims", type=int, default=64)
     ev.add_argument("--max-moves", type=int, default=200)
     ev.add_argument("--seed", type=int, default=0)
+    ev.add_argument(
+        "--gumbel",
+        action="store_true",
+        help="Evaluate with exploit-mode Gumbel search (deterministic "
+        "logits + sigma(q) argmax) instead of greedy PUCT.",
+    )
     ev.add_argument(
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
