@@ -1,0 +1,60 @@
+"""Shared arena-play helpers for strength evaluation.
+
+Used by `cli eval` (checkpoint vs random / head-to-head) and
+`benchmarks/elo_ladder.py`. The paired-hands property all arena
+comparisons lean on: reset keys are fixed by `seed` and the engine's
+shape draws depend only on the step index (the key chain splits every
+step regardless of action), so game i sees the same hand sequence under
+every policy — comparisons are paired, stripping the hand-luck variance
+that dominates this game.
+"""
+
+from collections.abc import Callable
+
+import numpy as np
+
+
+def play(
+    env,
+    policy_fn: Callable,
+    games: int,
+    max_moves: int,
+    seed: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Roll `games` paired hands under `policy_fn(states, move) -> (B,)
+    actions`; returns (scores, lengths, done) as NumPy arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    states = env.reset_batch(jax.random.split(jax.random.PRNGKey(seed), games))
+    for move in range(max_moves):
+        if bool(np.asarray(states.done).all()):
+            break
+        actions = policy_fn(states, move)
+        states, _, _ = env.step_batch(
+            states, jnp.asarray(actions, dtype=jnp.int32)
+        )
+    return (
+        np.asarray(states.score),
+        np.asarray(states.step_count),
+        np.asarray(states.done),
+    )
+
+
+def greedy_mcts_policy(net, mcts, use_gumbel: bool = False) -> Callable:
+    """Deterministic play from a search: visit-count argmax (PUCT) or
+    the final-candidate selection (Gumbel exploit mode). Reads
+    `net.variables` at call time, so one compiled search serves any
+    number of weight restores."""
+    import jax
+
+    def policy(states, move):
+        out = mcts.search(
+            net.variables, states, jax.random.PRNGKey(7000 + move)
+        )
+        if use_gumbel:
+            return np.maximum(np.asarray(out.selected_action), 0)
+        counts = np.asarray(out.visit_counts)
+        return np.where(counts.sum(axis=1) > 0, counts.argmax(axis=1), 0)
+
+    return policy

@@ -100,6 +100,13 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         help="Gumbel root search with sequential halving instead of "
         "PUCT+Dirichlet (stronger at small sim budgets).",
     )
+    p.add_argument(
+        "--checkpoint-freq",
+        type=int,
+        default=None,
+        metavar="STEPS",
+        help="Checkpoint every N learner steps (CHECKPOINT_SAVE_FREQ_STEPS).",
+    )
     p.add_argument("--no-per", action="store_true")
     p.add_argument(
         "--no-auto-resume",
@@ -177,6 +184,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["NUM_SELF_PLAY_WORKERS"] = args.workers
     if args.replay_ratio is not None:
         overrides["REPLAY_RATIO"] = args.replay_ratio
+    if args.checkpoint_freq is not None:
+        overrides["CHECKPOINT_SAVE_FREQ_STEPS"] = args.checkpoint_freq
     if args.no_per:
         overrides["USE_PER"] = False
     if args.no_auto_resume:
@@ -401,44 +410,17 @@ def cmd_eval(args: argparse.Namespace) -> int:
             )
         return BatchedMCTS(env, extractor, n.model, mcts_cfg, n.support)
 
+    from .arena import greedy_mcts_policy, play as arena_play
+
     net, source = restore_net(args.checkpoint, args.run_name)
     mcts = build_search(net)
     B = args.games
     rng = np.random.default_rng(args.seed)
 
     def play(policy_fn):
-        states = env.reset_batch(
-            jax.random.split(jax.random.PRNGKey(args.seed), B)
-        )
-        for move in range(args.max_moves):
-            done = np.asarray(states.done)
-            if done.all():
-                break
-            actions = policy_fn(states, move)
-            states, _, _ = env.step_batch(
-                states, jnp.asarray(actions, dtype=jnp.int32)
-            )
-        return (
-            np.asarray(states.score),
-            np.asarray(states.step_count),
-            np.asarray(states.done),
-        )
+        return arena_play(env, policy_fn, B, args.max_moves, args.seed)
 
-    def make_mcts_policy(search, n):
-        def policy(states, move):
-            out = search.search(
-                n.variables, states, jax.random.PRNGKey(7000 + move)
-            )
-            if args.gumbel:
-                return np.maximum(np.asarray(out.selected_action), 0)
-            counts = np.asarray(out.visit_counts)
-            return np.where(
-                counts.sum(axis=1) > 0, counts.argmax(axis=1), 0
-            )
-
-        return policy
-
-    mcts_policy = make_mcts_policy(mcts, net)
+    mcts_policy = greedy_mcts_policy(net, mcts, use_gumbel=args.gumbel)
 
     def random_policy(states, move):
         masks = np.asarray(env.valid_mask_batch(states))
@@ -476,7 +458,9 @@ def cmd_eval(args: argparse.Namespace) -> int:
     if args.vs_checkpoint or args.vs_run:
         net_b, source_b = restore_net(args.vs_checkpoint, args.vs_run)
         mcts_b = build_search(net_b)
-        b_scores, _, _ = play(make_mcts_policy(mcts_b, net_b))
+        b_scores, _, _ = play(
+            greedy_mcts_policy(net_b, mcts_b, use_gumbel=args.gumbel)
+        )
         h2h = scores - b_scores
         report.update(
             {

@@ -1,0 +1,158 @@
+"""Elo ladder over a run's checkpoints: paired round-robin arena.
+
+Restores every checkpoint of a run (or an explicit list), plays each
+pair head-to-head on the SAME paired hands (identical reset keys +
+step-indexed shape draws, so hand luck cancels — the property the
+`eval` command's arena also leans on), and fits Elo ratings to the
+pairwise win rates by logistic regression (simple iterative update).
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/elo_ladder.py --run-name my_run \
+      [--root-dir DIR] [--games 64] [--sims 32] [--max-moves 120]
+
+Writes benchmarks/elo_ladder_<run>.json and prints the table.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from alphatriangle_tpu.utils.helpers import enforce_platform  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run-name", required=True)
+    ap.add_argument("--root-dir", default=None)
+    ap.add_argument("--games", type=int, default=64)
+    ap.add_argument("--sims", type=int, default=32)
+    ap.add_argument("--max-moves", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+    ap.add_argument(
+        "--max-checkpoints",
+        type=int,
+        default=6,
+        help="Evenly subsample to at most this many rungs.",
+    )
+    args = ap.parse_args()
+    enforce_platform(args.device or "auto")
+
+    import numpy as np
+
+    from alphatriangle_tpu.arena import greedy_mcts_policy, play
+    from alphatriangle_tpu.config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        PersistenceConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+    from alphatriangle_tpu.env.engine import TriangleEnv
+    from alphatriangle_tpu.features.core import get_feature_extractor
+    from alphatriangle_tpu.mcts import BatchedMCTS
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+    from alphatriangle_tpu.rl import Trainer
+    from alphatriangle_tpu.stats.persistence import CheckpointManager
+
+    env_cfg = EnvConfig()
+    model_cfg = ModelConfig(
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg)
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    train_cfg = TrainConfig(RUN_NAME=args.run_name)
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+
+    persistence = PersistenceConfig(RUN_NAME=args.run_name)
+    if args.root_dir:
+        persistence = persistence.model_copy(
+            update={"ROOT_DATA_DIR": args.root_dir}
+        )
+    ckpt_dir = persistence.get_checkpoint_dir()
+    steps = sorted(
+        int(p.name.split("_")[1])
+        for p in ckpt_dir.iterdir()
+        if p.is_dir() and p.name.startswith("step_")
+    )
+    if len(steps) < 2:
+        raise SystemExit(f"Need >=2 checkpoints under {ckpt_dir}; found {steps}")
+    if len(steps) > args.max_checkpoints:
+        idx = np.linspace(0, len(steps) - 1, args.max_checkpoints)
+        steps = [steps[int(i)] for i in idx]
+    print(f"ladder rungs (steps): {steps}")
+
+    # One net + trainer + compiled search; each rung restores its
+    # weights into the SAME NeuralNetwork (greedy_mcts_policy reads
+    # net.variables at call time), so the heavy search program
+    # compiles once for the whole ladder.
+    mgr = CheckpointManager(persistence)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    trainer = Trainer(net, train_cfg)
+    mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
+    policy = greedy_mcts_policy(net, mcts)
+
+    # Scores are deterministic per rung given the fixed keys, so the
+    # full round-robin needs one playout per rung.
+    scores = {}
+    for step in steps:
+        loaded = mgr.restore_path(
+            str(ckpt_dir / f"step_{step:08d}"), trainer.state
+        )
+        assert loaded.train_state is not None, step
+        trainer.set_state(loaded.train_state)
+        trainer.sync_to_network()
+        scores[step], _, _ = play(
+            env, policy, args.games, args.max_moves, args.seed
+        )
+
+    n = len(steps)
+    wins = np.zeros((n, n))
+    for i, a in enumerate(steps):
+        for j, b in enumerate(steps):
+            if i == j:
+                continue
+            d = scores[a] - scores[b]
+            wins[i, j] = (d > 0).mean() + 0.5 * (d == 0).mean()
+
+    # Elo fit: iterative logistic (Bradley-Terry in Elo units).
+    elo = np.zeros(n)
+    for _ in range(200):
+        expected = 1.0 / (1.0 + 10 ** ((elo[None, :] - elo[:, None]) / 400.0))
+        np.fill_diagonal(expected, 0.0)
+        grad = (wins - expected).sum(axis=1)
+        elo += 8.0 * grad
+        elo -= elo.mean()
+
+    table = [
+        {
+            "step": steps[i],
+            "elo": round(float(elo[i]), 1),
+            "mean_score": round(float(scores[steps[i]].mean()), 3),
+            "mean_winrate": round(
+                float(wins[i].sum() / max(n - 1, 1)), 3
+            ),
+        }
+        for i in range(n)
+    ]
+    table.sort(key=lambda r: -r["elo"])
+    out = {
+        "run": args.run_name,
+        "games": args.games,
+        "sims": args.sims,
+        "ladder": table,
+    }
+    out_path = Path(__file__).parent / f"elo_ladder_{args.run_name}.json"
+    out_path.write_text(json.dumps(out, indent=2))
+    for row in table:
+        print(row)
+
+
+if __name__ == "__main__":
+    main()
