@@ -15,6 +15,7 @@ import argparse
 import logging
 import subprocess
 import sys
+from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
@@ -337,17 +338,12 @@ def cmd_eval(args: argparse.Namespace) -> int:
 
     enforce_platform(args.device or "auto")
 
-    import jax
-    import jax.numpy as jnp
-
     from .config import (
         AlphaTriangleMCTSConfig,
-        EnvConfig,
-        ModelConfig,
         PersistenceConfig,
         TrainConfig,
-        expected_other_features_dim,
     )
+    from .config.run_configs import load_run_configs_or_default
     from .env.engine import TriangleEnv
     from .features.core import get_feature_extractor
     from .mcts import BatchedMCTS
@@ -355,9 +351,19 @@ def cmd_eval(args: argparse.Namespace) -> int:
     from .rl import Trainer
     from .stats.persistence import CheckpointManager
 
-    env_cfg = EnvConfig()
-    model_cfg = ModelConfig(
-        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg)
+    def run_base_dir(run_name: str):
+        persistence = PersistenceConfig(RUN_NAME=run_name)
+        if args.root_dir:
+            persistence = persistence.model_copy(
+                update={"ROOT_DATA_DIR": args.root_dir}
+            )
+        return persistence.get_run_base_dir()
+
+    # Evaluate on the RUN'S OWN board/net configs when available
+    # (configs.json in the run dir) — the flagship defaults only apply
+    # to runs that actually used them.
+    env_cfg, model_cfg = load_run_configs_or_default(
+        run_base_dir(args.run_name) if args.run_name else Path("/nonexistent")
     )
     mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
     train_cfg = TrainConfig(RUN_NAME=args.run_name or "eval")
@@ -365,10 +371,12 @@ def cmd_eval(args: argparse.Namespace) -> int:
     env = TriangleEnv(env_cfg)
     extractor = get_feature_extractor(env, model_cfg)
 
-    def restore_net(checkpoint: str | None, run_name: str | None):
+    def restore_net(
+        checkpoint: str | None, run_name: str | None, net_model_cfg=None
+    ):
         """Fresh net, optionally restored from a checkpoint path or a
         run's latest checkpoint. Returns (net, source-label)."""
-        n = NeuralNetwork(model_cfg, env_cfg, seed=0)
+        n = NeuralNetwork(net_model_cfg or model_cfg, env_cfg, seed=0)
         label = "untrained"
         if checkpoint or run_name:
             trainer = Trainer(n, train_cfg)
@@ -456,7 +464,20 @@ def cmd_eval(args: argparse.Namespace) -> int:
 
     # Head-to-head: a second checkpoint plays the SAME paired hands.
     if args.vs_checkpoint or args.vs_run:
-        net_b, source_b = restore_net(args.vs_checkpoint, args.vs_run)
+        model_cfg_b = None
+        if args.vs_run:
+            env_b, model_cfg_b = load_run_configs_or_default(
+                run_base_dir(args.vs_run)
+            )
+            if env_b != env_cfg:
+                raise SystemExit(
+                    "Head-to-head needs both runs on the same env "
+                    f"config; {args.vs_run!r} trained on a different "
+                    "board."
+                )
+        net_b, source_b = restore_net(
+            args.vs_checkpoint, args.vs_run, model_cfg_b
+        )
         mcts_b = build_search(net_b)
         b_scores, _, _ = play(
             greedy_mcts_policy(net_b, mcts_b, use_gumbel=args.gumbel)

@@ -1,0 +1,50 @@
+"""Reload a run's own configs from its `configs.json` dump.
+
+Every run writes its validated config set to
+`runs/<run>/configs.json` (stats/persistence.py; reference parity:
+`README.md:79`). Post-hoc tools — arena eval, the Elo ladder — must
+rebuild the SAME env/model the checkpoints were trained with, not
+assume the flagship defaults, or restores fail (or silently evaluate a
+mismatched board).
+"""
+
+import json
+import logging
+from pathlib import Path
+
+from .env_config import EnvConfig
+from .model_config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def load_run_configs(run_dir: Path) -> dict | None:
+    """{'env': EnvConfig, 'model': ModelConfig} from a run directory's
+    configs.json, or None when the dump is absent/unreadable."""
+    path = Path(run_dir) / "configs.json"
+    if not path.is_file():
+        return None
+    try:
+        raw = json.loads(path.read_text())
+        return {
+            "env": EnvConfig(**raw["env"]),
+            "model": ModelConfig(**raw["model"]),
+        }
+    except (ValueError, KeyError, TypeError, OSError) as exc:
+        logger.warning("Could not load %s (%s); using defaults.", path, exc)
+        return None
+
+
+def load_run_configs_or_default(run_dir: Path) -> tuple[EnvConfig, ModelConfig]:
+    """The run's own (env, model) configs, or the flagship defaults
+    when no usable configs.json exists — the shared fallback for
+    post-hoc tools (cli eval, the Elo ladder)."""
+    from .validation import expected_other_features_dim
+
+    loaded = load_run_configs(run_dir)
+    if loaded:
+        return loaded["env"], loaded["model"]
+    env = EnvConfig()
+    return env, ModelConfig(
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env)
+    )

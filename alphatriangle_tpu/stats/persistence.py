@@ -168,13 +168,21 @@ class CheckpointManager:
 
     # --- load -------------------------------------------------------------
 
-    def latest_step(self) -> int | None:
-        steps = [
+    def list_steps(self) -> list[int]:
+        """Sorted steps of every canonical checkpoint directory
+        (non-matching names — e.g. Orbax temp dirs from an interrupted
+        save — are ignored, not crashed on)."""
+        if not self._ckpt_dir.exists():
+            return []
+        return sorted(
             int(m.group(1))
             for p in self._ckpt_dir.iterdir()
             if p.is_dir() and (m := _STEP_DIR_RE.match(p.name))
-        ] if self._ckpt_dir.exists() else []
-        return max(steps) if steps else None
+        )
+
+    def latest_step(self) -> int | None:
+        steps = self.list_steps()
+        return steps[-1] if steps else None
 
     def restore(
         self,

@@ -48,11 +48,8 @@ def main() -> None:
     from alphatriangle_tpu.arena import greedy_mcts_policy, play
     from alphatriangle_tpu.config import (
         AlphaTriangleMCTSConfig,
-        EnvConfig,
-        ModelConfig,
         PersistenceConfig,
         TrainConfig,
-        expected_other_features_dim,
     )
     from alphatriangle_tpu.env.engine import TriangleEnv
     from alphatriangle_tpu.features.core import get_feature_extractor
@@ -61,26 +58,27 @@ def main() -> None:
     from alphatriangle_tpu.rl import Trainer
     from alphatriangle_tpu.stats.persistence import CheckpointManager
 
-    env_cfg = EnvConfig()
-    model_cfg = ModelConfig(
-        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg)
-    )
-    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
-    train_cfg = TrainConfig(RUN_NAME=args.run_name)
-    env = TriangleEnv(env_cfg)
-    extractor = get_feature_extractor(env, model_cfg)
-
     persistence = PersistenceConfig(RUN_NAME=args.run_name)
     if args.root_dir:
         persistence = persistence.model_copy(
             update={"ROOT_DATA_DIR": args.root_dir}
         )
-    ckpt_dir = persistence.get_checkpoint_dir()
-    steps = sorted(
-        int(p.name.split("_")[1])
-        for p in ckpt_dir.iterdir()
-        if p.is_dir() and p.name.startswith("step_")
+
+    # Rebuild the run's own board/net from its configs.json dump.
+    from alphatriangle_tpu.config.run_configs import (
+        load_run_configs_or_default,
     )
+
+    env_cfg, model_cfg = load_run_configs_or_default(
+        persistence.get_run_base_dir()
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    train_cfg = TrainConfig(RUN_NAME=args.run_name)
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    ckpt_dir = persistence.get_checkpoint_dir()
+    mgr = CheckpointManager(persistence)
+    steps = mgr.list_steps()
     if len(steps) < 2:
         raise SystemExit(f"Need >=2 checkpoints under {ckpt_dir}; found {steps}")
     if len(steps) > args.max_checkpoints:
@@ -92,7 +90,6 @@ def main() -> None:
     # weights into the SAME NeuralNetwork (greedy_mcts_policy reads
     # net.variables at call time), so the heavy search program
     # compiles once for the whole ladder.
-    mgr = CheckpointManager(persistence)
     net = NeuralNetwork(model_cfg, env_cfg, seed=0)
     trainer = Trainer(net, train_cfg)
     mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
@@ -114,12 +111,17 @@ def main() -> None:
 
     n = len(steps)
     wins = np.zeros((n, n))
+    # Clip away 0/1 winrates: the Bradley-Terry MLE is unbounded for a
+    # never-lost pairing, so an unclipped fit would just ride the
+    # iteration cap instead of the data.
+    eps = 1.0 / (2.0 * args.games)
     for i, a in enumerate(steps):
         for j, b in enumerate(steps):
             if i == j:
                 continue
             d = scores[a] - scores[b]
-            wins[i, j] = (d > 0).mean() + 0.5 * (d == 0).mean()
+            w = (d > 0).mean() + 0.5 * (d == 0).mean()
+            wins[i, j] = min(max(w, eps), 1.0 - eps)
 
     # Elo fit: iterative logistic (Bradley-Terry in Elo units).
     elo = np.zeros(n)
