@@ -186,6 +186,7 @@ class SelfPlayEngine:
         self._episode_lengths: list[int] = []
         self._episode_start_versions: list[int] = []
         self._episodes_played = 0
+        self._episodes_truncated = 0
         self._total_simulations = 0
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
@@ -336,6 +337,11 @@ class SelfPlayEngine:
 
         episode = {
             "ending": ending,
+            # Truncated = hit MAX_EPISODE_MOVES rather than a natural
+            # game over; a high fraction means the cap is biting (the
+            # health signal the reference's get_game_over_reason
+            # served, `worker.py:196`).
+            "truncated": truncated,
             "score": new_states.score,
             "length": step_counts,
             "start_version": carry.episode_start_version,
@@ -451,6 +457,7 @@ class SelfPlayEngine:
                 episode["start_version"][ending].astype(int).tolist()
             )
             self._episodes_played += int(ending.sum())
+            self._episodes_truncated += int(episode["truncated"][ending].sum())
         sentinels = int(host["sentinel_live"].sum())
         if sentinels:
             logger.warning(
@@ -493,6 +500,7 @@ class SelfPlayEngine:
             episode_lengths=self._episode_lengths,
             episode_start_versions=self._episode_start_versions,
             num_episodes=self._episodes_played,
+            num_truncated=self._episodes_truncated,
             total_simulations=self._total_simulations,
             trainer_step_at_episode_start=(
                 self._min_weights_version
@@ -505,6 +513,7 @@ class SelfPlayEngine:
         self._episode_lengths = []
         self._episode_start_versions = []
         self._episodes_played = 0
+        self._episodes_truncated = 0
         self._total_simulations = 0
         self._min_weights_version = None
         return result

@@ -37,6 +37,9 @@ class SelfPlayResult(BaseModel):
     # than the window-level `trainer_step_at_episode_start` below.
     episode_start_versions: list[int] = []
     num_episodes: int = 0
+    # Episodes that hit MAX_EPISODE_MOVES instead of a natural game
+    # over (a persistently high fraction means the cap is biting).
+    num_truncated: int = 0
     total_simulations: int = 0
     # Weight version the producing rollout ran with (staleness tag,
     # reference `rl/types.py:22` / `worker.py:136-139`).

@@ -146,6 +146,11 @@ class TrainingLoop:
                     global_step=step,
                 ),
                 RawMetricEvent(
+                    name="SelfPlay/Truncated_Fraction",
+                    value=result.num_truncated / result.num_episodes,
+                    global_step=step,
+                ),
+                RawMetricEvent(
                     name="SelfPlay/Staleness_Steps",
                     value=(
                         c.net.weights_version

@@ -77,6 +77,19 @@ class TestBasics:
         assert all(s >= 0 for s in result.episode_lengths)
         assert result.total_simulations > 0
 
+    def test_truncation_counted(self, world):
+        """Games hitting MAX_EPISODE_MOVES are counted as truncated;
+        natural game-overs are not."""
+        engine, _ = make_engine(world, MAX_EPISODE_MOVES=3)
+        result = engine.play_moves(9)
+        assert result.num_episodes > 0
+        # A 3-move cap on the tiny board truncates most episodes.
+        assert 0 < result.num_truncated <= result.num_episodes
+
+        natural, _ = make_engine(world, MAX_EPISODE_MOVES=500)
+        r2 = natural.play_moves(25)
+        assert r2.num_episodes > 0 and r2.num_truncated == 0
+
     def test_harvest_clears(self, world):
         engine, _ = make_engine(world)
         engine.play_moves(6)
