@@ -43,12 +43,23 @@ from alphatriangle_tpu.rl import ExperienceBuffer, SelfPlayEngine, Trainer
 
 
 def build():
-    env_cfg = EnvConfig(
-        ROWS=3,
-        COLS=4,
-        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
-        NUM_SHAPE_SLOTS=1,
-    )
+    # LEARN_BOARD=small: 4x6/2-slot — a meaningfully larger decision
+    # space than the luck-bounded 3x4 (action_dim 48 vs 12, two-slot
+    # choice), still CPU-tractable.
+    if os.environ.get("LEARN_BOARD") == "small":
+        env_cfg = EnvConfig(
+            ROWS=4,
+            COLS=6,
+            PLAYABLE_RANGE_PER_ROW=[(0, 6)] * 4,
+            NUM_SHAPE_SLOTS=2,
+        )
+    else:
+        env_cfg = EnvConfig(
+            ROWS=3,
+            COLS=4,
+            PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+            NUM_SHAPE_SLOTS=1,
+        )
     model_cfg = ModelConfig(
         GRID_INPUT_CHANNELS=1,
         CONV_FILTERS=[16],
@@ -210,7 +221,11 @@ def main() -> None:
                     run_eval(steps)
 
     results = {
-        "board": "3x4/1-slot",
+        "board": (
+            "4x6/2-slot"
+            if os.environ.get("LEARN_BOARD") == "small"
+            else "3x4/1-slot"
+        ),
         "max_steps": max_steps,
         "eval_games_per_point": eval_games * 2,
         "self_play_curve": [
@@ -227,6 +242,8 @@ def main() -> None:
         results["greedy_final"] = eval_points[-1][1]
         results["improved"] = eval_points[-1][1] > eval_points[0][1]
     suffix = "_gumbel" if os.environ.get("LEARN_GUMBEL") == "1" else ""
+    if os.environ.get("LEARN_BOARD") == "small":
+        suffix += "_small"
     if os.environ.get("LEARN_PCR") == "1":
         suffix += "_pcr"
     if suffix.startswith("_gumbel"):
