@@ -68,6 +68,22 @@ class TrainConfig(BaseModel):
     # Bounded harvest queue between producer and learner (backpressure:
     # the producer blocks when the learner falls this many chunks behind).
     ROLLOUT_QUEUE_MAX: int = Field(default=4, ge=1)
+    # Pipelined learner (overlapped mode only): dispatch fused group
+    # N+1 to the device BEFORE fetching group N's results, so the
+    # learner always has a program queued behind the producers' rollout
+    # chunks and never blocks a full tunnel round trip per group. Costs
+    # one extra group of PER-priority staleness (bounded by
+    # FUSED_LEARNER_STEPS); False restores strictly serial fetches.
+    PIPELINE_LEARNER: bool = Field(default=True)
+    # Target wall-clock seconds per producer rollout dispatch in
+    # overlapped mode. A flagship chunk of ROLLOUT_CHUNK_MOVES moves is
+    # a single multi-second device program the learner's dispatches
+    # must queue behind (measured 0.02 learner steps/s at 16-move
+    # ~10 s chunks); producers auto-shrink their per-dispatch move
+    # count until a chunk fits this budget, bounding the learner's
+    # queue wait. None disables auto-tuning (dispatch
+    # ROLLOUT_CHUNK_MOVES every time).
+    ASYNC_CHUNK_SECONDS: float | None = Field(default=2.0, gt=0)
 
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)

@@ -381,43 +381,96 @@ class Trainer:
         device→host fetch for the whole group. Returns the per-step
         (metrics, local TD errors) list, in execution order.
         """
-        if not batches:
+        handle = self.train_steps_begin(batches)
+        if handle is None:
             return []
-        if len(batches) == 1:
-            out = self.train_step(batches[0])
-            return [out] if out is not None else []
+        return self.train_steps_finish(handle)
+
+    def train_steps_begin(
+        self, batches: "list[DenseBatch]"
+    ) -> dict | None:
+        """Stage + dispatch a fused group WITHOUT fetching results.
+
+        The dispatch is asynchronous: this returns as soon as the
+        host→device transfer is enqueued, so a caller can overlap the
+        group's device execution with host work (PER sampling, harvest
+        folding) and with *staging the next group* — the double-buffered
+        pipeline the overlapped training loop runs. Fetch the results
+        later with `train_steps_finish`; `self.state` is already the
+        group-end state (as a device future), so `sync_to_network` and
+        checkpointing may run before the fetch.
+
+        Returns an opaque handle, or None when `batches` is empty or
+        the batch is degenerate (same skip contract as `train_step`).
+        """
+        if not batches:
+            return None
         n = int(np.asarray(batches[0]["value_target"]).shape[0])
         if n == 0:  # same skip contract as train_step
-            return []
+            return None
         self._check_local_batch(n)
         batches = [self._with_policy_weight(dict(b), n) for b in batches]
-        stacked_host = {
-            key: np.stack([np.asarray(b[key]) for b in batches])
-            for key in batches[0]
-        }
-        if jax.process_count() > 1:
-            stacked = jax.tree_util.tree_map(
-                lambda x: jax.make_array_from_process_local_data(
-                    self._stacked_shard, x
-                ),
-                stacked_host,
-            )
+        if len(batches) == 1:
+            # Single-step groups reuse the per-step program (a fused
+            # K=1 program would recompile for nothing).
+            device_batch = shard_batch(self.mesh, batches[0], self.dp_axis)
+            self.state, metrics, td = self._step_fn(self.state, device_batch)
+            handle: dict = {"k": 1, "metrics": metrics, "td": td}
         else:
-            stacked = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, self._stacked_shard), stacked_host
+            stacked_host = {
+                key: np.stack([np.asarray(b[key]) for b in batches])
+                for key in batches[0]
+            }
+            if jax.process_count() > 1:
+                stacked = jax.tree_util.tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        self._stacked_shard, x
+                    ),
+                    stacked_host,
+                )
+            else:
+                stacked = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, self._stacked_shard),
+                    stacked_host,
+                )
+            self.state, metrics_k, td_k = self._multi_step_fn(
+                self.state, stacked
             )
-        self.state, metrics_k, td_k = self._multi_step_fn(self.state, stacked)
+            handle = {"k": len(batches), "metrics": metrics_k, "td": td_k}
+        # The dispatch semantically runs the steps; advance the host
+        # mirror now so LR lookups / buffer sampling for the NEXT group
+        # see the post-group step while this group still executes.
+        handle["start_step"] = self._host_step
+        self._host_step += handle["k"]
+        return handle
+
+    def train_steps_finish(
+        self, handle: dict
+    ) -> list[tuple[dict[str, float], np.ndarray]]:
+        """Blocking fetch of a `train_steps_begin` group's results.
+
+        ONE device→host transfer for the whole group. Returns the
+        per-step (metrics, local TD errors) list, in execution order.
+        """
+        k = handle["k"]
+        metrics_k, td_k = handle["metrics"], handle["td"]
         host_metrics_k, td_host = jax.device_get(
             (metrics_k, td_k if jax.process_count() == 1 else None)
         )
         if td_host is None:
-            td_host = local_rows(td_k, axis=1)
+            td_host = local_rows(td_k, axis=1 if k > 1 else 0)
         td_host = np.asarray(td_host)
+        if k == 1:
+            host_metrics_k = {
+                key: np.asarray(v)[None] for key, v in host_metrics_k.items()
+            }
+            td_host = td_host[None]
         results = []
-        for i in range(len(batches)):
-            self._host_step += 1
-            m = {k: float(v[i]) for k, v in host_metrics_k.items()}
-            m["learning_rate"] = self.get_current_lr()
+        for i in range(k):
+            m = {key: float(v[i]) for key, v in host_metrics_k.items()}
+            m["learning_rate"] = float(
+                self.schedule(handle["start_step"] + i + 1)
+            )
             results.append((m, td_host[i]))
         return results
 

@@ -26,6 +26,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from enum import Enum
 
 import jax
@@ -60,6 +61,14 @@ class TrainingLoop:
         self.experiences_added = 0  # this run (resume-independent)
         self._steps_this_run = 0
         self._producer_error: BaseException | None = None
+        # Pipelined learner (overlapped mode): fused groups dispatched
+        # but not yet fetched, oldest first. Each entry is
+        # (trainer handle, samples list).
+        self._inflight: deque = deque()
+        # Async chunk auto-tune: producers publish one shared tuned
+        # move count (first accurate measurement wins).
+        self._tune_lock = threading.Lock()
+        self._tuned_chunk_moves: int | None = None
         self._last_saved_step: int | None = None
         self._last_buffer_saved_step: int | None = None
         self._cadence_anchor = 0  # resume step; cadence baseline
@@ -280,6 +289,42 @@ class TrainingLoop:
         """
         return self._run_training_steps(1) == 1
 
+    def _learner_budget(self, allowed: int) -> int:
+        """Steps the learner may still dispatch: the caller's allowance
+        capped by MAX_TRAINING_STEPS, counting steps already inflight
+        (inflight is empty outside the pipelined pump)."""
+        budget = allowed
+        if self.cfg.MAX_TRAINING_STEPS is not None:
+            budget = min(
+                budget,
+                self.cfg.MAX_TRAINING_STEPS
+                - self.global_step
+                - self._inflight_steps(),
+            )
+        return budget
+
+    def _sample_group(self, group: int) -> list:
+        """Sample up to `group` training batches from the buffer.
+
+        BATCH_SIZE is the GLOBAL batch; in a multi-host run each host
+        samples its share from its local buffer and shard_batch
+        assembles the global array (trainer returns local TD rows).
+        The PER-beta clock is the trainer's dispatch-time step (equal
+        to `global_step` whenever nothing is inflight).
+        """
+        local_batch = max(1, self.cfg.BATCH_SIZE // jax.process_count())
+        with self.profile.phase("sample"):
+            samples = []
+            for _ in range(group):
+                s = self.c.buffer.sample(
+                    local_batch,
+                    current_train_step=self.c.trainer.global_step,
+                )
+                if s is None:
+                    break
+                samples.append(s)
+        return samples
+
     def _run_training_steps(self, max_steps: int) -> int:
         """Up to `max_steps` learner steps, dispatched in fused groups
         of `FUSED_LEARNER_STEPS`. Returns the number of steps run.
@@ -289,30 +334,14 @@ class TrainingLoop:
         checkpoint and weight-sync cadences run at group boundaries.
         """
         c = self.c
-        # BATCH_SIZE is the GLOBAL batch; in a multi-host run each host
-        # samples its share from its local buffer and shard_batch
-        # assembles the global array (trainer returns local TD rows).
-        local_batch = max(1, self.cfg.BATCH_SIZE // jax.process_count())
         k = max(1, self.cfg.FUSED_LEARNER_STEPS)
         ran = 0
         while ran < max_steps and not self.stop_event.is_set():
-            budget = max_steps - ran
-            if self.cfg.MAX_TRAINING_STEPS is not None:
-                budget = min(
-                    budget, self.cfg.MAX_TRAINING_STEPS - self.global_step
-                )
+            budget = self._learner_budget(max_steps - ran)
             if budget <= 0:
                 break
             group = min(k, budget)
-            with self.profile.phase("sample"):
-                samples = []
-                for _ in range(group):
-                    s = c.buffer.sample(
-                        local_batch, current_train_step=self.global_step
-                    )
-                    if s is None:
-                        break
-                    samples.append(s)
+            samples = self._sample_group(group)
             if not samples:
                 break
             prev_step = self.global_step
@@ -354,12 +383,34 @@ class TrainingLoop:
         anchor = last if last is not None else self._cadence_anchor
         return step > 0 and step // freq > anchor // freq
 
+    def _ckpt_save_due(self, force: bool = False) -> bool:
+        return force or self._crossed(
+            self.global_step,
+            self.cfg.CHECKPOINT_SAVE_FREQ_STEPS,
+            self._last_saved_step,
+        )
+
+    def _buffer_save_due(self, force: bool = False) -> bool:
+        return self.c.persistence_config.SAVE_BUFFER and (
+            force
+            or self._crossed(
+                self.global_step,
+                self.c.persistence_config.BUFFER_SAVE_FREQ_STEPS,
+                self._last_buffer_saved_step,
+            )
+        )
+
+    def _checkpoint_due(self) -> bool:
+        """Either save cadence pending? The pipelined pump drains the
+        inflight groups before `_maybe_checkpoint` whenever this is
+        True; both sides call the same per-cadence predicates, so the
+        drain decision and the save decision cannot drift apart."""
+        return self._ckpt_save_due() or self._buffer_save_due()
+
     def _maybe_checkpoint(self, force: bool = False) -> None:
         c = self.c
         step = self.global_step
-        due = force or self._crossed(
-            step, self.cfg.CHECKPOINT_SAVE_FREQ_STEPS, self._last_saved_step
-        )
+        due = self._ckpt_save_due(force)
         if due and self._last_saved_step != step:
             self._last_saved_step = step
             c.checkpoints.save(
@@ -371,14 +422,7 @@ class TrainingLoop:
                     "weight_updates": self.weight_updates,
                 },
             )
-        save_buffer = c.persistence_config.SAVE_BUFFER and (
-            force
-            or self._crossed(
-                step,
-                c.persistence_config.BUFFER_SAVE_FREQ_STEPS,
-                self._last_buffer_saved_step,
-            )
-        )
+        save_buffer = self._buffer_save_due(force)
         # On force, always spill: late harvests may have been folded
         # into the buffer after a cadence save at this same step (the
         # async shutdown path does exactly that).
@@ -465,7 +509,56 @@ class TrainingLoop:
 
     # --- overlapped producer/consumer ------------------------------------
 
-    def _producer_loop(self, engine, out: "queue.Queue") -> None:
+    def _producer_chunk_moves(self) -> int:
+        """Current per-dispatch move count for producers (tuned or
+        configured)."""
+        with self._tune_lock:
+            if self._tuned_chunk_moves is not None:
+                return self._tuned_chunk_moves
+        return self.cfg.ROLLOUT_CHUNK_MOVES
+
+    def _maybe_tune_chunk(self, moves: int, dt: float, warmed: bool) -> None:
+        """Auto-size async rollout dispatches from one clean measurement.
+
+        A single flagship chunk is a multi-second device program; every
+        learner dispatch queues behind it (device programs run FIFO),
+        so the chunk length directly sets the learner's worst-case
+        queue wait. The first post-compile chunk's wall time gives
+        seconds/move; producers then dispatch
+        `ASYNC_CHUNK_SECONDS / seconds_per_move` moves at a time. The
+        measurement may include learner time slices (conservative:
+        over-shrinks, never starves). One shared tuned size — streams
+        reuse one compiled program.
+        """
+        target = self.cfg.ASYNC_CHUNK_SECONDS
+        if target is None or not warmed:
+            return
+        with self._tune_lock:
+            if self._tuned_chunk_moves is not None:
+                return
+            per_move = dt / max(moves, 1)
+            tuned = max(
+                1,
+                min(self.cfg.ROLLOUT_CHUNK_MOVES, round(target / per_move)),
+            )
+            # Build the tuned size's jit wrapper here, inside the lock,
+            # so producer threads don't race the engine's program cache
+            # with concurrent first misses.
+            if tuned != moves:
+                self.c.self_play._chunk_fn(tuned)
+                logger.info(
+                    "Async chunk auto-tune: %.2fs/%d moves measured "
+                    "(%.2fs/move) -> %d moves/dispatch for the %.1fs "
+                    "target.",
+                    dt,
+                    moves,
+                    per_move,
+                    tuned,
+                    target,
+                )
+            self._tuned_chunk_moves = tuned
+
+    def _producer_loop(self, engine, out: "queue.Queue", stream: int = 0) -> None:
         """Self-play producer: play chunks, enqueue (harvest, trace).
 
         Runs in a daemon thread (one per rollout stream — the
@@ -480,18 +573,26 @@ class TrainingLoop:
         """
         try:
             while not self.stop_event.is_set():
+                moves = self._producer_chunk_moves()
                 # Timed as "rollout" here — in async mode the producers
                 # own the self-play device time; the consumer's queue
-                # drain is timed separately as "fold".
+                # drain is timed separately as "fold". Chunk sizing is
+                # settled before producers start (`_run_async`'s
+                # uncontended measurement) — a producer-side sample
+                # would include the other streams' queued programs.
                 with self.profile.phase("rollout"):
-                    result = engine.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
+                    result = engine.play_moves(moves)
                 item = (result, engine.last_trace)
-                while not self.stop_event.is_set():
-                    try:
-                        out.put(item, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
+                # Backpressure wait, timed per stream: persistent high
+                # wait here means the consumer (fold + learner) is the
+                # bottleneck, not self-play.
+                with self.profile.phase(f"enqueue_wait/stream{stream}"):
+                    while not self.stop_event.is_set():
+                        try:
+                            out.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
         except BaseException as exc:  # surface in the consumer thread
             self._producer_error = exc
             self.stop_event.set()
@@ -502,20 +603,114 @@ class TrainingLoop:
         REPLAY_RATIO = samples consumed per experience produced, i.e.
         allowed steps = produced * ratio / BATCH_SIZE. Counted within
         this run so a resumed `global_step` doesn't starve the gate.
+        Dispatched-but-unfetched pipeline groups count as consumed.
         """
         target = (
             self.experiences_added * self.cfg.REPLAY_RATIO / self.cfg.BATCH_SIZE
         )
-        return max(0, int(target) - self._steps_this_run)
+        return max(
+            0, int(target) - self._steps_this_run - self._inflight_steps()
+        )
+
+    # --- pipelined learner (overlapped mode) ------------------------------
+
+    def _inflight_steps(self) -> int:
+        return sum(handle["k"] for handle, _ in self._inflight)
+
+    def _dispatch_learner_group(self, allowed: int) -> bool:
+        """Sample + dispatch ONE fused group without fetching results.
+
+        Returns True when a group went out. The dispatch returns as
+        soon as the transfer is enqueued, so the group's device
+        execution overlaps the consumer's queue draining and the NEXT
+        group's sampling — and, crucially, sits in the device FIFO
+        behind at most one producer chunk instead of idling a full
+        round trip per group.
+        """
+        c = self.c
+        k = max(1, self.cfg.FUSED_LEARNER_STEPS)
+        group = min(k, self._learner_budget(allowed))
+        if group <= 0 or self.stop_event.is_set():
+            return False
+        samples = self._sample_group(group)
+        if not samples:
+            return False
+        with self.profile.phase("dispatch"):
+            if len(samples) == k and k > 1:
+                handle = c.trainer.train_steps_begin(
+                    [s["batch"] for s in samples]
+                )
+                groups = [(handle, samples)] if handle is not None else []
+            else:
+                # Short groups ride the per-step program one batch per
+                # handle: a fused program per distinct group size would
+                # recompile (same guard as _run_training_steps).
+                groups = []
+                for s in samples:
+                    handle = c.trainer.train_steps_begin([s["batch"]])
+                    if handle is None:
+                        break
+                    groups.append((handle, [s]))
+        if not groups:
+            return False
+        self._inflight.extend(groups)
+        return True
+
+    def _finish_oldest_group(self) -> int:
+        """Blocking fetch + bookkeeping for the oldest inflight group.
+
+        Weight sync after a finish installs the trainer's CURRENT state
+        — possibly one group fresher than the step label when another
+        group is already inflight; fresher-than-labeled is harmless
+        (self-play only ever wants the newest weights).
+        """
+        handle, samples = self._inflight.popleft()
+        with self.profile.phase("train"):
+            outs = self.c.trainer.train_steps_finish(handle)
+        prev_step = self.global_step
+        for i, (s, (metrics, td_errors)) in enumerate(zip(samples, outs)):
+            self._record_step(
+                metrics, td_errors, s["indices"], prev_step + i + 1
+            )
+        self._maybe_sync_weights(prev_step)
+        return len(outs)
+
+    def _drain_learner(self) -> int:
+        ran = 0
+        while self._inflight:
+            ran += self._finish_oldest_group()
+        return ran
+
+    def _pump_learner(self, allowed: int) -> int:
+        """One pipelined learner beat: dispatch group N+1, then fetch
+        group N. Keeps exactly one group executing and one queued in
+        steady state; empties naturally when the gate or buffer starves
+        the dispatch. Checkpoints drain the pipeline first so the saved
+        params and the step label agree exactly.
+        """
+        dispatched = self._dispatch_learner_group(allowed)
+        ran = 0
+        while len(self._inflight) >= 2:
+            ran += self._finish_oldest_group()
+        if self._inflight and not dispatched:
+            ran += self._finish_oldest_group()
+        if ran and self._checkpoint_due():
+            ran += self._drain_learner()
+            with self.profile.phase("checkpoint"):
+                self._maybe_checkpoint()
+        return ran
 
     def _make_rollout_streams(self) -> list:
         """The primary engine plus NUM_SELF_PLAY_WORKERS-1 extra
-        independent streams (own carry + seed, shared net/weights)."""
+        independent streams (own carry + seed, shared net/weights).
+        The count is clamped to the host/device budget (reference
+        clamps its actors to cores-2, `setup.py:106-151`)."""
         from ..rl.self_play import SelfPlayEngine
+        from .setup import clamp_self_play_workers
 
         primary = self.c.self_play
         streams = [primary]
-        for i in range(1, self.cfg.NUM_SELF_PLAY_WORKERS):
+        for i in range(1, clamp_self_play_workers(self.cfg.NUM_SELF_PLAY_WORKERS)):
             streams.append(
                 SelfPlayEngine(
                     primary.env,
@@ -536,10 +731,28 @@ class TrainingLoop:
         # producer threads race the lru_cache: concurrent first misses
         # may each build (and compile) their own wrapper.
         self.c.self_play._chunk_fn(cfg.ROLLOUT_CHUNK_MOVES)
+        if cfg.ASYNC_CHUNK_SECONDS is not None:
+            # Auto-size async dispatches from an UNCONTENDED measurement
+            # taken before any producer or learner work exists: with N
+            # streams already running, a producer's own chunk wall time
+            # includes the other streams' queued programs and would
+            # over-shrink the tuned size N-fold. Chunk 1 compiles;
+            # chunk 2 times clean seconds/move. Both harvests feed the
+            # buffer — nothing is thrown away.
+            self._fold_result(
+                self.c.self_play.play_moves(cfg.ROLLOUT_CHUNK_MOVES)
+            )
+            t0 = time.perf_counter()
+            result = self.c.self_play.play_moves(cfg.ROLLOUT_CHUNK_MOVES)
+            dt = time.perf_counter() - t0
+            self._fold_result(result)
+            self._maybe_tune_chunk(
+                cfg.ROLLOUT_CHUNK_MOVES, dt, warmed=True
+            )
         producers = [
             threading.Thread(
                 target=self._producer_loop,
-                args=(engine, harvests),
+                args=(engine, harvests, i),
                 name=f"self-play-producer-{i}",
                 daemon=True,
             )
@@ -581,9 +794,14 @@ class TrainingLoop:
                             folded += 1
                         except queue.Empty:
                             pass
-                steps_ran = self._run_training_steps(
-                    self._learner_steps_allowed()
-                )
+                if self.cfg.PIPELINE_LEARNER:
+                    steps_ran = self._pump_learner(
+                        self._learner_steps_allowed()
+                    )
+                else:
+                    steps_ran = self._run_training_steps(
+                        self._learner_steps_allowed()
+                    )
                 if folded == 0 and steps_ran == 0:
                     # Gate open but the buffer can't produce a batch yet
                     # (or the trainer rejected one): don't busy-spin.
@@ -604,6 +822,12 @@ class TrainingLoop:
                 self._iteration_tail()
         finally:
             self.stop_event.set()
+            # Land any dispatched-but-unfetched learner groups so their
+            # steps are recorded before the final checkpoint.
+            try:
+                self._drain_learner()
+            except Exception:
+                logger.exception("Draining inflight learner groups failed.")
             for producer in producers:
                 producer.join(timeout=30.0)
                 if producer.is_alive():

@@ -8,6 +8,7 @@ are no actors to tear down on failure.
 """
 
 import logging
+import os
 
 from ..config.env_config import EnvConfig
 from ..config.mcts_config import MCTSConfig
@@ -28,6 +29,45 @@ from ..stats.persistence import CheckpointManager
 from .components import TrainingComponents
 
 logger = logging.getLogger(__name__)
+
+# Each rollout stream keeps roughly one multi-second chunk program in
+# the device FIFO at all times; past a few streams per chip the learner
+# and the streams only inflate each other's queue waits.
+MAX_STREAMS_PER_DEVICE = 4
+
+
+def clamp_self_play_workers(requested: int) -> int:
+    """Clamp rollout-stream count to the host + device budget.
+
+    The reference clamps its Ray self-play actors to cores-2
+    (`alphatriangle/training/setup.py:106-151`). Streams here are
+    producer threads driving device-batched engines, so two budgets
+    apply: host threads (cores-2, the reference's rule — each stream
+    burns a core on harvest compaction) and device dispatch depth
+    (MAX_STREAMS_PER_DEVICE per local chip). Returns the effective
+    count, warning when it clamps.
+    """
+    import jax
+
+    cores = os.cpu_count() or 1
+    cap = max(
+        1,
+        min(
+            cores - 2 if cores > 2 else 1,
+            MAX_STREAMS_PER_DEVICE * jax.local_device_count(),
+        ),
+    )
+    if requested > cap:
+        logger.warning(
+            "NUM_SELF_PLAY_WORKERS=%d exceeds this host's budget "
+            "(%d cores, %d local device(s)); clamping to %d streams.",
+            requested,
+            cores,
+            jax.local_device_count(),
+            cap,
+        )
+        return cap
+    return requested
 
 
 def setup_training_components(

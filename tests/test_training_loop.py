@@ -264,6 +264,83 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    def test_pipeline_disabled_still_completes(
+        self, tmp_path, tiny_world_configs
+    ):
+        """PIPELINE_LEARNER=False restores the strictly serial
+        dispatch-then-fetch path."""
+        c = build(
+            tmp_path, tiny_world_configs, run_name="serial_async",
+            ASYNC_ROLLOUTS=True, PIPELINE_LEARNER=False,
+            MAX_TRAINING_STEPS=4,
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 4
+        assert not loop._inflight
+        c.stats.close()
+        c.checkpoints.close()
+
+    def test_pipelined_fused_groups(self, tmp_path, tiny_world_configs):
+        """Pipelined pump + fused groups: steps, cadences and the final
+        checkpoint all land; nothing is left inflight."""
+        c = build(
+            tmp_path, tiny_world_configs, run_name="pipelined_run",
+            ASYNC_ROLLOUTS=True, FUSED_LEARNER_STEPS=2,
+            MAX_TRAINING_STEPS=8,
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        assert loop.global_step == 8
+        assert not loop._inflight
+        assert c.checkpoints.latest_step() == 8
+        assert c.stats.latest("Loss/total_loss") is not None
+        c.stats.close()
+        c.checkpoints.close()
+
+    def test_async_chunk_autotune(self, tmp_path, tiny_world_configs):
+        """One clean chunk measurement sizes async dispatches to the
+        ASYNC_CHUNK_SECONDS budget (shared across streams)."""
+        c = build(
+            tmp_path, tiny_world_configs, run_name="tune_run",
+            ASYNC_ROLLOUTS=True, ASYNC_CHUNK_SECONDS=2.0,
+        )
+        loop = TrainingLoop(c)
+        # Not warmed (compile chunk): no tuning.
+        loop._maybe_tune_chunk(4, dt=4.0, warmed=False)
+        assert loop._tuned_chunk_moves is None
+        assert loop._producer_chunk_moves() == 4
+        # 4 moves took 4s -> 1s/move -> 2 moves fit the 2s target.
+        loop._maybe_tune_chunk(4, dt=4.0, warmed=True)
+        assert loop._tuned_chunk_moves == 2
+        assert loop._producer_chunk_moves() == 2
+        # First accurate measurement wins; later ones don't retune.
+        loop._maybe_tune_chunk(2, dt=0.1, warmed=True)
+        assert loop._tuned_chunk_moves == 2
+        c.stats.close()
+        c.checkpoints.close()
+
+    def test_worker_clamp(self, monkeypatch):
+        """Stream counts clamp to cores-2 and the per-device budget
+        (reference clamps actors to cores-2, setup.py:106-151)."""
+        import os as os_mod
+
+        from alphatriangle_tpu.training.setup import (
+            clamp_self_play_workers,
+        )
+
+        monkeypatch.setattr(os_mod, "cpu_count", lambda: 4)
+        assert clamp_self_play_workers(1) == 1
+        assert clamp_self_play_workers(2) == 2
+        assert clamp_self_play_workers(8) == 2  # cores-2 wins
+        monkeypatch.setattr(os_mod, "cpu_count", lambda: 64)
+        import jax as jax_mod
+
+        cap = 4 * jax_mod.local_device_count()
+        assert clamp_self_play_workers(10_000) == min(62, cap)
+
     def test_producer_error_surfaces(self, tmp_path, tiny_world_configs):
         """A crash in the producer thread fails the run instead of
         silently starving the learner."""
