@@ -1,0 +1,140 @@
+"""Analytic FLOP accounting + MFU for the benchmark and profiler.
+
+The reference publishes no utilization numbers at all; BASELINE.md's
+throughput rows say nothing about how much of the chip they use. This
+module turns ModelConfig/EnvConfig into an analytic forward FLOP count
+(matmul/conv terms only — norms, activations and elementwise adds are
+bandwidth, not FLOP, bound on TPU) so `bench.py` can report achieved
+TFLOP/s and %-of-peak (MFU) next to every games/h row.
+
+Conventions:
+- 1 MAC = 2 FLOPs.
+- A backward pass costs ~2x the forward matmul FLOPs (grad wrt inputs
+  + grad wrt weights), so a train step is ~3x forward; `nn.remat`
+  recomputes the forward once more (~4x). `train_step_flops` applies
+  the right multiplier from ModelConfig.REMAT.
+- Peak table covers the chips this framework targets; unknown device
+  kinds return None and the bench reports MFU as null rather than
+  guessing.
+"""
+
+from ..config.env_config import EnvConfig
+from ..config.model_config import ModelConfig
+
+
+def _conv2d_flops(h: int, w: int, cin: int, cout: int, k: int, s: int) -> int:
+    """SAME-padded k x k conv at stride s over (h, w): 2*HWK^2*Cin*Cout."""
+    ho = -(-h // s)
+    wo = -(-w // s)
+    return 2 * ho * wo * k * k * cin * cout
+
+
+def forward_flops(model: ModelConfig, env: EnvConfig, action_dim: int) -> int:
+    """Matmul/conv FLOPs of ONE forward pass of `AlphaTriangleNet`
+    (nn/model.py) for ONE example."""
+    h, w = env.ROWS, env.COLS
+    total = 0
+
+    # Conv trunk.
+    cin = model.GRID_INPUT_CHANNELS
+    for f, k, s in zip(
+        model.CONV_FILTERS, model.CONV_KERNEL_SIZES, model.CONV_STRIDES
+    ):
+        total += _conv2d_flops(h, w, cin, f, k, s)
+        h, w = -(-h // s), -(-w // s)
+        cin = f
+
+    # Residual stack (+ 1x1 adapter when widths differ).
+    if model.NUM_RESIDUAL_BLOCKS > 0:
+        rf = model.RESIDUAL_BLOCK_FILTERS
+        if cin != rf:
+            total += _conv2d_flops(h, w, cin, rf, 1, 1)
+            cin = rf
+        total += model.NUM_RESIDUAL_BLOCKS * 2 * _conv2d_flops(
+            h, w, rf, rf, 3, 1
+        )
+
+    # Transformer over the S = h*w token sequence.
+    if model.USE_TRANSFORMER and model.TRANSFORMER_LAYERS > 0:
+        d = model.TRANSFORMER_DIM
+        if cin != d:
+            total += _conv2d_flops(h, w, cin, d, 1, 1)
+            cin = d
+        s_len = h * w
+        per_layer = (
+            4 * 2 * s_len * d * d  # Q, K, V, out projections
+            + 2 * 2 * s_len * s_len * d  # QK^T and attn @ V
+            + 2 * 2 * s_len * d * model.TRANSFORMER_FC_DIM  # MLP in + out
+        )
+        total += model.TRANSFORMER_LAYERS * per_layer
+
+    # Heads over the flattened features (+ the auxiliary scalar input).
+    flat = h * w * cin + model.OTHER_NN_INPUT_FEATURES_DIM
+    dim = flat
+    for fc in model.FC_DIMS_SHARED:
+        total += 2 * dim * fc
+        dim = fc
+    for dims, out in (
+        (model.POLICY_HEAD_DIMS, action_dim),
+        (model.VALUE_HEAD_DIMS, model.NUM_VALUE_ATOMS),
+    ):
+        hd = dim
+        for fc in dims:
+            total += 2 * hd * fc
+            hd = fc
+        total += 2 * hd * out
+    return total
+
+
+def train_step_flops(
+    model: ModelConfig, env: EnvConfig, action_dim: int, batch: int
+) -> int:
+    """Matmul FLOPs of one SGD step on a `batch`: forward + ~2x
+    backward (+1x forward recompute under REMAT)."""
+    mult = 4 if model.REMAT else 3
+    return mult * batch * forward_flops(model, env, action_dim)
+
+
+def gather_einsum_flops(batch: int, wave: int, nodes: int, width: int) -> int:
+    """FLOPs of ONE einsum descent row-gather (`ops/gather_rows.py`):
+    (B, W, N) one-hot x (B, N, K). The take/pallas lowerings do the
+    same row select with zero matmul FLOPs."""
+    return 2 * batch * wave * nodes * width
+
+
+# Peak dense bf16 matmul throughput per chip, TFLOP/s. Public figures:
+# v4 275, v5e (v5 lite) 394, v5p 459, v6e (Trillium) 918.
+_PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 394.0,
+    "TPU v5e": 394.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def peak_bf16_tflops(device_kind: str) -> float | None:
+    """Peak bf16 TFLOP/s for a `jax.Device.device_kind`, or None."""
+    kind = (device_kind or "").strip()
+    if kind in _PEAK_BF16_TFLOPS:
+        return _PEAK_BF16_TFLOPS[kind]
+    # Longest-prefix fallback, space-insensitive: device kinds vary
+    # across runtime versions ("TPU v5 lite" vs "TPU v5litepod-8").
+    norm = kind.lower().replace(" ", "")
+    best = None
+    for name, peak in _PEAK_BF16_TFLOPS.items():
+        key = name.lower().replace(" ", "")
+        if norm.startswith(key) and (best is None or len(key) > best[0]):
+            best = (len(key), peak)
+    return best[1] if best else None
+
+
+def mfu(achieved_flops_per_sec: float, device_kind: str) -> float | None:
+    """Fraction of the chip's bf16 peak actually achieved, or None for
+    unknown hardware (never guess a denominator)."""
+    peak = peak_bf16_tflops(device_kind)
+    if peak is None or achieved_flops_per_sec <= 0:
+        return None
+    return achieved_flops_per_sec / (peak * 1e12)

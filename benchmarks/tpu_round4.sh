@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 on-chip measurement sweep (run only in a healthy-chip window;
+# probe first: timeout 60 python -c "import jax; print(jax.devices())").
+# Each section appends its JSON line to benchmarks/tpu_r4_results.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+out=benchmarks/tpu_r4_results.jsonl
+run() {
+  label="$1"; shift
+  echo "=== $label ===" >&2
+  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 timeout 900 python bench.py)
+  echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
+}
+# 1. Flagship, new default recipe (gumbel+PCR) + pipelined overlap + MFU.
+run flagship_gumbel_pcr BENCH_SECONDS=75
+# 2. Reference-parity PUCT for comparison.
+run flagship_puct BENCH_RECIPE=puct BENCH_SECONDS=60
+# 3. Gather lowering A/B (short windows).
+run gather_pallas BENCH_GATHER=pallas BENCH_SECONDS=45
+run gather_take BENCH_GATHER=take BENCH_SECONDS=45
+# 4. BASELINE presets 2-5.
+run preset2 BENCH_CONFIG=2 BENCH_SECONDS=60
+run preset3 BENCH_CONFIG=3 BENCH_SECONDS=60
+run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
+run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
+# 5. Multi-stream overlap.
+run flagship_workers2 BENCH_WORKERS=2 BENCH_SECONDS=60
+echo "sweep complete" >&2

@@ -113,3 +113,52 @@ def test_point_in_polygon():
     assert is_point_in_polygon((1, 1), square)
     assert not is_point_in_polygon((3, 3), square)
     assert is_point_in_polygon((0, 0), square)  # vertex counts as inside
+
+
+class TestFlops:
+    """Analytic FLOP accounting (utils/flops.py) used by bench MFU."""
+
+    def test_forward_flops_positive_and_scales(self):
+        from alphatriangle_tpu.config import (
+            EnvConfig,
+            ModelConfig,
+            expected_other_features_dim,
+        )
+        from alphatriangle_tpu.utils.flops import (
+            forward_flops,
+            train_step_flops,
+        )
+
+        env = EnvConfig()
+        feat = expected_other_features_dim(env)
+        small = ModelConfig(
+            OTHER_NN_INPUT_FEATURES_DIM=feat, TRANSFORMER_LAYERS=2
+        )
+        big = ModelConfig(
+            OTHER_NN_INPUT_FEATURES_DIM=feat, TRANSFORMER_LAYERS=4
+        )
+        f_small = forward_flops(small, env, env.action_dim)
+        f_big = forward_flops(big, env, env.action_dim)
+        assert 0 < f_small < f_big
+        # Two extra layers add exactly the per-layer cost.
+        s = env.ROWS * env.COLS
+        d, m = small.TRANSFORMER_DIM, small.TRANSFORMER_FC_DIM
+        per_layer = 8 * s * d * d + 4 * s * s * d + 4 * s * d * m
+        assert f_big - f_small == 2 * per_layer
+        # Train step: 3x forward without remat, 4x with.
+        assert train_step_flops(small, env, env.action_dim, 8) == (
+            3 * 8 * f_small
+        )
+        remat = small.model_copy(update={"REMAT": True})
+        assert train_step_flops(remat, env, env.action_dim, 8) == (
+            4 * 8 * f_small
+        )
+
+    def test_peak_table_and_mfu(self):
+        from alphatriangle_tpu.utils.flops import mfu, peak_bf16_tflops
+
+        assert peak_bf16_tflops("TPU v5 lite") == 394.0
+        assert peak_bf16_tflops("TPU v5litepod-8") == 394.0
+        assert peak_bf16_tflops("cpu") is None
+        assert mfu(394e12 / 2, "TPU v5 lite") == 0.5
+        assert mfu(1.0, "unknown-chip") is None
