@@ -361,10 +361,17 @@ def cmd_eval(args: argparse.Namespace) -> int:
 
     # Evaluate on the RUN'S OWN board/net configs when available
     # (configs.json in the run dir) — the flagship defaults only apply
-    # to runs that actually used them.
-    env_cfg, model_cfg = load_run_configs_or_default(
-        run_base_dir(args.run_name) if args.run_name else Path("/nonexistent")
-    )
+    # to runs that actually used them. An explicit --checkpoint without
+    # --run-name still has a run dir: checkpoints live at
+    # <run>/checkpoints/step_XXXXXXXX, so the run's configs.json sits
+    # two parents up from the step directory.
+    if args.run_name:
+        cfg_dir = run_base_dir(args.run_name)
+    elif args.checkpoint:
+        cfg_dir = Path(args.checkpoint).resolve().parent.parent
+    else:
+        cfg_dir = Path("/nonexistent")
+    env_cfg, model_cfg = load_run_configs_or_default(cfg_dir)
     mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
     train_cfg = TrainConfig(RUN_NAME=args.run_name or "eval")
 
@@ -407,16 +414,25 @@ def cmd_eval(args: argparse.Namespace) -> int:
                     label = f"{run_name} {label}"
         return n, label
 
-    def build_search(n):
+    def build_search(n, net_model_cfg=None):
+        # Each net searches with features built from ITS OWN model
+        # config: a --vs-run trained with different feature-affecting
+        # settings (e.g. GRID_INPUT_CHANNELS) must not be evaluated on
+        # run A's feature layout.
+        ext = (
+            get_feature_extractor(env, net_model_cfg)
+            if net_model_cfg is not None
+            else extractor
+        )
         if args.gumbel:
             # Gumbel-aware evaluation: exploit mode (no root Gumbel
             # sample) — deterministic argmax of logits + sigma(q).
             from .mcts import GumbelMCTS
 
             return GumbelMCTS(
-                env, extractor, n.model, mcts_cfg, n.support, exploit=True
+                env, ext, n.model, mcts_cfg, n.support, exploit=True
             )
-        return BatchedMCTS(env, extractor, n.model, mcts_cfg, n.support)
+        return BatchedMCTS(env, ext, n.model, mcts_cfg, n.support)
 
     from .arena import greedy_mcts_policy, play as arena_play
 
@@ -464,21 +480,26 @@ def cmd_eval(args: argparse.Namespace) -> int:
 
     # Head-to-head: a second checkpoint plays the SAME paired hands.
     if args.vs_checkpoint or args.vs_run:
+        from .config.run_configs import load_run_configs
+
         model_cfg_b = None
         if args.vs_run:
-            env_b, model_cfg_b = load_run_configs_or_default(
-                run_base_dir(args.vs_run)
-            )
+            cfg_dir_b = run_base_dir(args.vs_run)
+        else:
+            cfg_dir_b = Path(args.vs_checkpoint).resolve().parent.parent
+        loaded_b = load_run_configs(cfg_dir_b)
+        if loaded_b:
+            env_b, model_cfg_b = loaded_b["env"], loaded_b["model"]
             if env_b != env_cfg:
                 raise SystemExit(
                     "Head-to-head needs both runs on the same env "
-                    f"config; {args.vs_run!r} trained on a different "
+                    "config; the --vs side trained on a different "
                     "board."
                 )
         net_b, source_b = restore_net(
             args.vs_checkpoint, args.vs_run, model_cfg_b
         )
-        mcts_b = build_search(net_b)
+        mcts_b = build_search(net_b, model_cfg_b)
         b_scores, _, _ = play(
             greedy_mcts_policy(net_b, mcts_b, use_gumbel=args.gumbel)
         )

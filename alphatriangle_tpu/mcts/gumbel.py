@@ -87,8 +87,13 @@ class GumbelMCTS(BatchedMCTS):
         batch = root_states.done.shape[0]
         a = self.action_dim
         w = self.wave_size
-        rng, gumbel_rng, wave_rng = jax.random.split(rng, 3)
-        tree = self._init_tree(variables, root_states, gumbel_rng)
+        # Distinct keys for root init and the Gumbel sample: reusing
+        # one is harmless only while GumbelMCTS forces
+        # dirichlet_epsilon=0 (init never consumes its key); a fourth
+        # key keeps root noise and Gumbel perturbations independent if
+        # Dirichlet were ever re-enabled.
+        rng, init_rng, gumbel_rng, wave_rng = jax.random.split(rng, 4)
+        tree = self._init_tree(variables, root_states, init_rng)
 
         valid = tree.valid[:, 0, :] > 0  # (B, A)
         logits = jnp.where(

@@ -96,15 +96,21 @@ def ring_attention(
     o = jnp.zeros(q.shape, jnp.float32)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
+    # Fold the local block first, then permute-and-fold n_shards-1
+    # times: every fold sees the K/V block it needs and the last block
+    # is NOT permuted onward afterwards (a trailing ppermute would be
+    # pure dead ICI traffic unless XLA happens to DCE it).
+    m, l, o = _fold_block(q, k, v, m, l, o, scale)
+
     def hop(_, carry):
         m, l, o, k, v = carry
-        m, l, o = _fold_block(q, k, v, m, l, o, scale)
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
+        m, l, o = _fold_block(q, k, v, m, l, o, scale)
         return m, l, o, k, v
 
     m, l, o, k, v = jax.lax.fori_loop(
-        0, n_shards, hop, (m, l, o, k, v), unroll=True
+        0, n_shards - 1, hop, (m, l, o, k, v), unroll=True
     )
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
