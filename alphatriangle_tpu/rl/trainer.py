@@ -135,6 +135,7 @@ class Trainer:
         nn: NeuralNetwork,
         train_config: TrainConfig,
         mesh: Mesh | None = None,
+        mdl_axis: str | None = None,
     ):
         self.nn = nn
         self.config = train_config
@@ -146,6 +147,27 @@ class Trainer:
             "dp" if "dp" in self.mesh.axis_names else self.mesh.axis_names[0]
         )
         self.dp_size = self.mesh.shape[self.dp_axis]
+        # Model (tensor-parallel) axis: transformer params shard over
+        # it when it is wider than 1 (parallel/sharding.py Megatron
+        # layout); 1-wide or absent means fully-replicated state (the
+        # default — the flagship net is ~3M params). Only an axis
+        # DISTINCT from dp qualifies: guessing (e.g. taking the second
+        # axis of a custom-named mesh) could silently tensor-shard
+        # params over a data or sequence axis. Callers with custom
+        # axis names pass `mdl_axis` explicitly (setup.py forwards
+        # MeshConfig.MDL_AXIS).
+        if mdl_axis is None:
+            mdl_axis = "mdl" if "mdl" in self.mesh.axis_names else None
+        if (
+            mdl_axis is not None
+            and mdl_axis != self.dp_axis
+            and mdl_axis in self.mesh.axis_names
+        ):
+            self.mdl_axis: str | None = mdl_axis
+            self.tp_size = self.mesh.shape[mdl_axis]
+        else:
+            self.mdl_axis = None
+            self.tp_size = 1
         self.model = nn.model
         mc = nn.model_config
         self.num_atoms = mc.NUM_VALUE_ATOMS
@@ -166,7 +188,10 @@ class Trainer:
         )
 
         rep = replicated(self.mesh)
-        state_shard = state_shardings(self.mesh, self.state)
+        state_shard = state_shardings(
+            self.mesh, self.state, mdl_axis=self.mdl_axis
+        )
+        self._state_shard = state_shard
         bshard = batch_sharding(self.mesh, self.dp_axis)
         batch_shards: dict[str, Any] = {
             "grid": bshard,
@@ -195,8 +220,9 @@ class Trainer:
             donate_argnums=(0,),
         )
         self._stacked_shard = stacked_shard
-        # Keep state resident on the mesh, replicated.
-        self.state = jax.device_put(self.state, rep)
+        # Keep state resident on the mesh (replicated, or TP-sharded
+        # over the mdl axis when it is wider than 1).
+        self.state = jax.device_put(self.state, state_shard)
         # Host mirror of state.step: global_step / LR lookups must not
         # block on a device fetch (each fetch is a full round trip —
         # painful when the chip sits behind a network tunnel).
@@ -495,10 +521,24 @@ class Trainer:
         Ray weight broadcast, `worker_manager.py:169-209`).
 
         Hands the wrapper a device-side copy: the live state buffers get
-        donated by the next train step."""
-        self.nn.set_weights(
-            jax.tree_util.tree_map(jnp.array, self.get_variables())
-        )
+        donated by the next train step. Tensor-sharded params are
+        gathered first — the eval wrapper serves the single-device
+        self-play path, which wants whole tensors."""
+        variables = self.get_variables()
+        if self.tp_size > 1:
+            # On-device all-gather (ICI) first: after it every host
+            # holds full replicas (addressable even on multi-host
+            # meshes, no host round trip), then land each tensor on
+            # one local device — the eval wrapper serves the
+            # single-device self-play path.
+            variables = jax.device_put(variables, replicated(self.mesh))
+            dev0 = self.mesh.local_devices[0]
+            variables = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, dev0), variables
+            )
+        else:
+            variables = jax.tree_util.tree_map(jnp.array, variables)
+        self.nn.set_weights(variables)
         return self.nn.weights_version
 
     def set_state(self, state: TrainState) -> None:
@@ -508,5 +548,5 @@ class Trainer:
         arrays, and an aliased caller pytree would be deleted by the
         next step's donation."""
         state = jax.tree_util.tree_map(jnp.array, state)
-        self.state = jax.device_put(state, replicated(self.mesh))
+        self.state = jax.device_put(state, self._state_shard)
         self._host_step = int(self.state.step)  # one fetch, resume-only

@@ -132,7 +132,9 @@ def setup_training_components(
         seed=train_config.RANDOM_SEED,
         attention_fn=attention_fn,
     )
-    trainer = Trainer(net, train_config, mesh=mesh)
+    trainer = Trainer(
+        net, train_config, mesh=mesh, mdl_axis=mesh_config.MDL_AXIS
+    )
     buffer = ExperienceBuffer(train_config, action_dim=env_config.action_dim)
     self_play = SelfPlayEngine(
         env,

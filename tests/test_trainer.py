@@ -253,6 +253,120 @@ class TestFusedSteps:
         assert int(trainer.state.step) == 3
 
 
+class TestTensorParallel:
+    """Real mdl-axis tensor parallelism: transformer params shard
+    Megatron-style over the mesh's mdl axis, results match the
+    replicated learner, and the eval wrapper receives whole tensors."""
+
+    def _tx_config(self, tiny_model_config):
+        return tiny_model_config.model_copy(
+            update={
+                "USE_TRANSFORMER": True,
+                "TRANSFORMER_LAYERS": 1,
+                "TRANSFORMER_DIM": 8,
+                "TRANSFORMER_HEADS": 2,
+                "TRANSFORMER_FC_DIM": 16,
+            }
+        )
+
+    def test_tp_matches_replicated(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        from jax.sharding import PartitionSpec as P
+
+        from alphatriangle_tpu.config import MeshConfig
+
+        mc = self._tx_config(tiny_model_config)
+        batch = make_batch(16, seed=3)
+
+        net_rep = NeuralNetwork(mc, tiny_env_config, seed=0)
+        tr_rep = Trainer(
+            net_rep,
+            tiny_train_config,
+            mesh=MeshConfig(DP_SIZE=8).build_mesh(),
+        )
+        net_tp = NeuralNetwork(mc, tiny_env_config, seed=0)
+        tr_tp = Trainer(
+            net_tp,
+            tiny_train_config,
+            mesh=MeshConfig(DP_SIZE=4, MDL_SIZE=2).build_mesh(),
+        )
+        assert tr_tp.tp_size == 2
+
+        # Transformer QKV kernels sharded on heads; MLP Dense_0 on
+        # columns; everything else replicated.
+        def spec_of(substr):
+            flat = jax.tree_util.tree_flatten_with_path(
+                tr_tp.state.params
+            )[0]
+            for path, leaf in flat:
+                name = "/".join(str(k.key) for k in path)
+                if substr in name:
+                    return name, leaf.sharding.spec
+            raise AssertionError(f"no param matching {substr}")
+
+        _, qspec = spec_of("query/kernel")
+        assert qspec == P(None, "mdl", None)
+        _, d0spec = spec_of("TransformerEncoderLayer_0/Dense_0/kernel")
+        assert d0spec == P(None, "mdl")
+        # The top-level shared-FC Dense_0 is NOT a transformer MLP and
+        # stays replicated.
+        flat = jax.tree_util.tree_flatten_with_path(tr_tp.state.params)[0]
+        for path, leaf in flat:
+            name = "/".join(str(k.key) for k in path)
+            if name == "Dense_0/kernel":
+                assert leaf.sharding.spec == P()
+        _, convspec = spec_of("ConvBlock_0/Conv_0/kernel")
+        assert convspec == P()
+
+        out_rep = tr_rep.train_step(dict(batch))
+        out_tp = tr_tp.train_step(dict(batch))
+        m_rep, td_rep = out_rep
+        m_tp, td_tp = out_tp
+        np.testing.assert_allclose(td_rep, td_tp, rtol=1e-4, atol=1e-5)
+        for key in m_rep:
+            assert m_rep[key] == pytest.approx(
+                m_tp[key], rel=1e-3, abs=1e-5
+            ), key
+
+        # Weight sync gathers shards: the eval wrapper gets whole,
+        # single-device tensors and still evaluates.
+        tr_tp.sync_to_network()
+        leaves = jax.tree_util.tree_leaves(net_tp.variables["params"])
+        assert all(
+            len(leaf.sharding.device_set) == 1 for leaf in leaves
+        )
+        policy, value = net_tp.evaluate_features(
+            np.asarray(batch["grid"]), np.asarray(batch["other_features"])
+        )
+        assert np.all(np.isfinite(np.asarray(policy)))
+
+    def test_indivisible_widths_fall_back_to_replication(
+        self, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        """Widths that don't divide the mdl axis replicate (never
+        crash, never shard unevenly)."""
+        from jax.sharding import PartitionSpec as P
+
+        from alphatriangle_tpu.config import MeshConfig
+
+        mc = self._tx_config(tiny_model_config).model_copy(
+            update={"TRANSFORMER_HEADS": 1}  # 1 head % mdl=2 != 0
+        )
+        net = NeuralNetwork(mc, tiny_env_config, seed=0)
+        tr = Trainer(
+            net,
+            tiny_train_config,
+            mesh=MeshConfig(DP_SIZE=4, MDL_SIZE=2).build_mesh(),
+        )
+        flat = jax.tree_util.tree_flatten_with_path(tr.state.params)[0]
+        for path, leaf in flat:
+            name = "/".join(str(k.key) for k in path)
+            if "query/kernel" in name:
+                assert leaf.sharding.spec == P()
+        assert tr.train_step(make_batch(16)) is not None
+
+
 class TestPipelinedSteps:
     """`train_steps_begin`/`train_steps_finish`: the overlapped loop's
     double-buffered dispatch path must be bit-equivalent to serial
