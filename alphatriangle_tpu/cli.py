@@ -108,6 +108,15 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         metavar="STEPS",
         help="Checkpoint every N learner steps (CHECKPOINT_SAVE_FREQ_STEPS).",
     )
+    p.add_argument(
+        "--keep-checkpoints",
+        type=int,
+        default=None,
+        metavar="K",
+        help="Retain the newest K checkpoints (KEEP_LAST_CHECKPOINTS; "
+        "default 5). Raise for post-hoc strength curves over a whole "
+        "run's checkpoints.",
+    )
     p.add_argument("--no-per", action="store_true")
     p.add_argument(
         "--no-auto-resume",
@@ -238,10 +247,13 @@ def cmd_train(args: argparse.Namespace) -> int:
         mcts_config = AlphaTriangleMCTSConfig(**mcts_kw)
 
     persistence_config = None
-    if args.root_dir is not None:
-        persistence_config = PersistenceConfig(
-            ROOT_DATA_DIR=args.root_dir, RUN_NAME=train_config.RUN_NAME
-        )
+    if args.root_dir is not None or args.keep_checkpoints is not None:
+        p_kw: dict = {"RUN_NAME": train_config.RUN_NAME}
+        if args.root_dir is not None:
+            p_kw["ROOT_DATA_DIR"] = args.root_dir
+        if args.keep_checkpoints is not None:
+            p_kw["KEEP_LAST_CHECKPOINTS"] = args.keep_checkpoints
+        persistence_config = PersistenceConfig(**p_kw)
     distributed_config = None
     if args.distributed or args.coordinator is not None:
         distributed_config = DistributedConfig(

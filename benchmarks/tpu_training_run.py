@@ -1,0 +1,265 @@
+"""Round-4 on-hardware training run: the flagship recipe, end to end.
+
+Drives the real CLI (separate processes, exactly what a user runs):
+
+1. Train the flagship preset (8x15 board, 4-layer transformer,
+   Gumbel+PCR search) in overlapped mode until `--kill-at` learner
+   steps, then deliver SIGINT mid-run — the reference's ctrl-C path.
+2. Resume the SAME run (auto-resume) to `--steps`, proving
+   checkpoint/resume under real device timing.
+3. Post-hoc strength curve: arena-eval every checkpoint (paired
+   hands vs the random baseline, Gumbel exploit search) and write
+   `benchmarks/tpu_training_curve.json`.
+
+Wedge resilience: the TPU behind the tunnel oscillates between healthy
+and wedged. Every phase watches checkpoint progress; a phase that
+makes no progress for --stall-minutes is killed and retried (resume
+picks up from the latest checkpoint), up to --retries times.
+
+Usage (healthy-chip window):
+    python benchmarks/tpu_training_run.py --steps 2000 --kill-at 600
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_training_run] {msg}", file=sys.stderr, flush=True)
+
+
+def checkpoint_dir(root: str, run_name: str) -> Path:
+    return Path(root) / "AlphaTriangleTPU" / "runs" / run_name / "checkpoints"
+
+
+def completed_steps(ckpt_dir: Path) -> list[int]:
+    """Step numbers of COMPLETED checkpoints (orbax writes
+    `step_XXXX.orbax-checkpoint-tmp-*` staging dirs first; skip any
+    name whose suffix isn't purely numeric)."""
+    steps = []
+    for p in ckpt_dir.glob("step_*"):
+        suffix = p.name.split("_", 1)[1]
+        if p.is_dir() and suffix.isdigit():
+            steps.append(int(suffix))
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: Path) -> int:
+    return max(completed_steps(ckpt_dir), default=0)
+
+
+def train_phase(
+    args, target_steps: int, kill_at: int | None, attempt: int
+) -> str:
+    """One training subprocess. Returns 'done', 'killed', or 'stalled'."""
+    cmd = [
+        sys.executable,
+        "-m",
+        "alphatriangle_tpu.cli",
+        "train",
+        "--preset",
+        "3",
+        "--async-rollouts",
+        "--workers",
+        str(args.workers),
+        "--fused-learner-steps",
+        str(args.fused),
+        "--max-steps",
+        str(target_steps),
+        "--run-name",
+        args.run_name,
+        "--root-dir",
+        args.root_dir,
+        "--checkpoint-freq",
+        str(args.checkpoint_freq),
+        "--min-buffer",
+        str(args.min_buffer),
+        "--keep-checkpoints",
+        "10000",  # keep everything: phase 3 evals the WHOLE curve
+        "--no-tensorboard",
+    ]
+    if args.smoke:
+        # Tiny CPU shakeout of THIS DRIVER's orchestration (kill,
+        # resume, stall watch, eval sweep) — not a performance run.
+        cmd += [
+            "--self-play-batch",
+            "8",
+            "--batch-size",
+            "8",
+            "--rollout-chunk",
+            "2",
+            "--buffer-capacity",
+            "2000",
+            "--device",
+            "cpu",
+        ]
+    log(f"attempt {attempt}: {' '.join(cmd[2:])}")
+    t_launch = time.time()
+    proc = subprocess.Popen(cmd, cwd=REPO)
+    ckpts = checkpoint_dir(args.root_dir, args.run_name)
+    last_progress = time.time()
+    last_seen = latest_step(ckpts)
+    killed = False
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            if killed:
+                return "killed"
+            if rc == 0:
+                return "done"
+            # A nonzero exit with zero checkpoint progress in the
+            # first couple of minutes is a deterministic crash (bad
+            # config, import error) — retrying the identical command
+            # is pointless and a retry loop would mask the failure.
+            if (
+                latest_step(ckpts) == last_seen
+                and time.time() - t_launch < 180
+            ):
+                return "crashed"
+            return "stalled"
+        step = latest_step(ckpts)
+        if step > last_seen:
+            last_seen = step
+            last_progress = time.time()
+            log(f"checkpoint at step {step}")
+        if kill_at is not None and step >= kill_at and not killed:
+            log(f"delivering SIGINT at step {step} (kill/resume exercise)")
+            proc.send_signal(signal.SIGINT)
+            killed = True
+        if time.time() - last_progress > args.stall_minutes * 60:
+            log(
+                f"no checkpoint progress in {args.stall_minutes} min; "
+                "killing this attempt (chip wedge?)"
+            )
+            proc.kill()
+            proc.wait(timeout=60)
+            return "stalled"
+        time.sleep(10.0)
+
+
+def eval_checkpoint(args, step: int | None) -> dict | None:
+    """Arena-eval one checkpoint (None = untrained net)."""
+    cmd = [
+        sys.executable,
+        "-m",
+        "alphatriangle_tpu.cli",
+        "eval",
+        "--games",
+        str(args.eval_games),
+        "--sims",
+        str(args.eval_sims),
+        "--gumbel",
+        "--max-moves",
+        str(args.eval_max_moves),
+        "--root-dir",
+        args.root_dir,
+    ]
+    if args.smoke:
+        cmd += ["--device", "cpu"]
+    if step is not None:
+        ckpt = checkpoint_dir(args.root_dir, args.run_name) / f"step_{step:08d}"
+        cmd += ["--run-name", args.run_name, "--checkpoint", str(ckpt)]
+    try:
+        out = subprocess.run(
+            cmd,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=args.stall_minutes * 60,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"eval of step {step} timed out")
+        return None
+    if out.returncode != 0:
+        log(f"eval of step {step} failed rc={out.returncode}")
+        return None
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2000)
+    ap.add_argument("--kill-at", type=int, default=600)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--fused", type=int, default=16)
+    ap.add_argument("--checkpoint-freq", type=int, default=250)
+    ap.add_argument("--min-buffer", type=int, default=25_000)
+    ap.add_argument("--eval-games", type=int, default=64)
+    ap.add_argument("--eval-sims", type=int, default=32)
+    ap.add_argument("--eval-max-moves", type=int, default=200)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--run-name", default="tpu_flagship_r4")
+    ap.add_argument("--root-dir", default="/tmp/tpu_r4_train")
+    ap.add_argument("--stall-minutes", type=float, default=25.0)
+    ap.add_argument("--retries", type=int, default=6)
+    ap.add_argument(
+        "--out", default=str(REPO / "benchmarks" / "tpu_training_curve.json")
+    )
+    args = ap.parse_args()
+
+    t_start = time.time()
+    events = []
+    # Phase 1+2: train to --steps with one deliberate mid-run SIGINT.
+    kill_pending = args.kill_at if args.kill_at > 0 else None
+    for attempt in range(1, args.retries + 1):
+        status = train_phase(args, args.steps, kill_pending, attempt)
+        step = latest_step(checkpoint_dir(args.root_dir, args.run_name))
+        events.append(
+            {"attempt": attempt, "status": status, "latest_step": step}
+        )
+        log(f"attempt {attempt}: {status} at step {step}")
+        if status == "crashed":
+            log("deterministic startup crash; aborting (not a chip wedge)")
+            return 1
+        if status == "killed":
+            kill_pending = None  # the resume that follows proves the path
+        if status == "done" and step >= args.steps:
+            break
+    else:
+        log("retries exhausted before reaching target steps")
+
+    # Phase 3: strength curve over every checkpoint.
+    ckpts = completed_steps(checkpoint_dir(args.root_dir, args.run_name))
+    curve = []
+    base = eval_checkpoint(args, None)
+    if base is not None:
+        curve.append({"step": 0, **base})
+    for step in ckpts:
+        r = eval_checkpoint(args, step)
+        if r is not None:
+            curve.append({"step": step, **r})
+            log(
+                f"step {step}: mean {r.get('mcts_mean_score')} "
+                f"(vs random x{r.get('score_vs_random')})"
+            )
+
+    payload = {
+        "recipe": "preset 3 (flagship): Gumbel+PCR, overlapped, "
+        f"workers={args.workers}, fused={args.fused}",
+        "target_steps": args.steps,
+        "kill_at": args.kill_at,
+        "wall_seconds": round(time.time() - t_start, 1),
+        "train_events": events,
+        "curve": curve,
+    }
+    Path(args.out).write_text(json.dumps(payload, indent=2))
+    log(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+
+
