@@ -527,14 +527,19 @@ class Trainer:
         variables = self.get_variables()
         if self.tp_size > 1:
             # On-device all-gather (ICI) first: after it every host
-            # holds full replicas (addressable even on multi-host
-            # meshes, no host round trip), then land each tensor on
-            # one local device — the eval wrapper serves the
-            # single-device self-play path.
+            # holds full replicas with no host round trip. Then hand
+            # the eval wrapper each tensor's LOCAL replica (a
+            # single-device array) — a multi-host replicated array
+            # cannot be device_put to one device directly (it spans
+            # non-addressable devices), but its first addressable
+            # shard IS the whole tensor, already resident locally.
             variables = jax.device_put(variables, replicated(self.mesh))
-            dev0 = self.mesh.local_devices[0]
+            # jnp.array COPIES the local replica: for leaves that were
+            # already replicated the device_put above is a no-op, and
+            # handing the wrapper the raw shard would alias live state
+            # buffers that the next train step donates.
             variables = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, dev0), variables
+                lambda x: jnp.array(x.addressable_shards[0].data), variables
             )
         else:
             variables = jax.tree_util.tree_map(jnp.array, variables)

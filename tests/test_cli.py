@@ -97,6 +97,19 @@ class TestTrainOverrides:
         assert mc.full_search_prob == 0.5
         assert captured["model_config"].USE_TRANSFORMER is False
 
+    def test_keep_checkpoints_flag(self, monkeypatch):
+        captured = self._capture(monkeypatch)
+        rc = cli.main(
+            [
+                "train",
+                "--max-steps", "5",
+                "--keep-checkpoints", "99",
+                "--no-tensorboard",
+            ]
+        )
+        assert rc == 0
+        assert captured["persistence_config"].KEEP_LAST_CHECKPOINTS == 99
+
     def test_full_search_prob_without_fast_sims_errors(self, monkeypatch):
         self._capture(monkeypatch)
         with pytest.raises(SystemExit):

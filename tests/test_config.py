@@ -104,6 +104,14 @@ def test_baseline_presets_valid():
         assert b["train"].SELF_PLAY_BATCH_SIZE >= 16
     assert baseline_preset(1)["model"].USE_TRANSFORMER is False
     assert baseline_preset(3)["model"].TRANSFORMER_LAYERS == 4
+    # The flagship preset carries the measured-best search recipe
+    # (Gumbel + playout cap randomization); the others stay PUCT so
+    # the BASELINE table remains comparable config-for-config.
+    p3_mcts = baseline_preset(3)["mcts"]
+    assert p3_mcts.root_selection == "gumbel"
+    assert p3_mcts.fast_simulations == 16
+    assert p3_mcts.full_search_prob == 0.25
+    assert baseline_preset(2)["mcts"].root_selection == "puct"
     assert baseline_preset(4)["mcts"].max_simulations == 400
     p5 = baseline_preset(5)
     assert p5["env"].ROWS == 12 and p5["model"].TRANSFORMER_LAYERS == 8

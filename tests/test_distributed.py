@@ -97,6 +97,11 @@ def test_two_process_train_step(tmp_path):
     assert field(outs[0], "PARAM_SUM") == field(outs[1], "PARAM_SUM")
     # Ring attention over the cross-process sp axis agrees too.
     assert field(outs[0], "SP_LOSS") == field(outs[1], "SP_LOSS")
+    # Tensor parallelism with mdl shards on different hosts agrees
+    # across processes. (TP_LOSS is not compared to SP_LOSS: the SP
+    # attention kernel disables attention-weight dropout, the dense
+    # one doesn't, so the two runs draw different dropout masks.)
+    assert field(outs[0], "TP_LOSS") == field(outs[1], "TP_LOSS")
     assert field(outs[0], "PRIMARY") == "1"
     assert field(outs[1], "PRIMARY") == "0"
 
