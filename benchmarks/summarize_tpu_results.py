@@ -1,0 +1,74 @@
+"""Render benchmarks/tpu_r4_results.jsonl as a BASELINE.md-ready table.
+
+`benchmarks/tpu_round4.sh` appends one labeled bench JSON per sweep
+section; this prints a markdown table (games/h, leaf-evals/s, learner
+steps/s, MFU, overlapped combined rates) plus the gather-lowering A/B
+verdict, so the measured numbers drop straight into BASELINE.md.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    path = Path(
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else Path(__file__).parent / "tpu_r4_results.jsonl"
+    )
+    if not path.is_file():
+        print(f"no results at {path}", file=sys.stderr)
+        return 1
+    rows = []
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError as exc:
+            # A killed bench (wedged chip mid-sweep) appends a
+            # malformed line; report it, keep the measured rows.
+            print(f"skipping malformed line {i}: {exc}", file=sys.stderr)
+
+    print(
+        "| label | backend | games/h | leaf-evals/s | learner steps/s "
+        "(fused) | self-play MFU | overlapped g/h (vs serial) | "
+        "overlapped steps/s |"
+    )
+    print("|---|---|---|---|---|---|---|---|")
+    gather = {}
+    for row in rows:
+        r = row["result"]
+        e = r.get("extra", {})
+        o = e.get("overlapped", {})
+        f = e.get("flops", {})
+        mfu = f.get("self_play_mfu")
+        print(
+            f"| {row['label']} | {e.get('backend')} | {r.get('value'):,} | "
+            f"{e.get('mcts_leaf_evals_per_sec')} | "
+            f"{e.get('learner_steps_per_sec_fused')} | "
+            f"{mfu if mfu is None else f'{100 * mfu:.1f}%'} | "
+            f"{o.get('games_per_hour')} ({o.get('vs_serialized_self_play')}) | "
+            f"{o.get('learner_steps_per_sec')} |"
+        )
+        # Only rows that actually recorded their lowering enter the
+        # A/B (an errored bench emits no descent_gather; defaulting it
+        # would overwrite a real einsum row with the failure's 0.0).
+        if (
+            row["label"].startswith("gather_")
+            or row["label"] == "flagship_gumbel_pcr"
+        ) and e.get("descent_gather"):
+            gather[e["descent_gather"]] = r.get("value")
+    if len(gather) > 1:
+        best = max(gather, key=lambda k: gather[k] or 0)
+        print(
+            f"\ngather A/B (games/h): "
+            + ", ".join(f"{k}={v}" for k, v in gather.items())
+            + f" -> best: {best}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
