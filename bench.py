@@ -147,10 +147,16 @@ def run_bench(smoke: bool, seconds: float) -> dict:
 
         bundle = baseline_preset(int(preset), run_name="bench")
         env_cfg, model_cfg = bundle["env"], bundle["model"]
-        mcts_cfg = bundle["mcts"].model_copy(
-            # Honor the A/B lowering knob here too.
-            update={"descent_gather": os.environ.get("BENCH_GATHER", "einsum")}
-        )
+        # Honor the A/B knobs in the preset path too (a silently
+        # ignored BENCH_WAVE would mislabel the measurement).
+        preset_mcts_updates = {
+            "descent_gather": os.environ.get("BENCH_GATHER", "einsum")
+        }
+        if os.environ.get("BENCH_WAVE"):
+            preset_mcts_updates["mcts_batch_size"] = int(
+                os.environ["BENCH_WAVE"]
+            )
+        mcts_cfg = bundle["mcts"].model_copy(update=preset_mcts_updates)
         train_updates = {
             "BUFFER_CAPACITY": 10_000,
             "MIN_BUFFER_SIZE_TO_TRAIN": 1_000,
@@ -211,6 +217,10 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             mcts_kw["full_search_prob"] = float(
                 os.environ.get("BENCH_FULL_PROB", "0.25")
             )
+        if os.environ.get("BENCH_WAVE"):
+            # Wave-size A/B: simulations evaluated in parallel per tree
+            # (the MXU batch per eval is SELF_PLAY_BATCH_SIZE x wave).
+            mcts_kw["mcts_batch_size"] = int(os.environ["BENCH_WAVE"])
         recipe = os.environ.get(
             "BENCH_RECIPE", "gumbel_pcr" if scale == "flagship" else "puct"
         )

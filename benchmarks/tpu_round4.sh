@@ -25,4 +25,10 @@ run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
 run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
 # 5. Multi-stream overlap.
 run flagship_workers2 BENCH_WORKERS=2 BENCH_SECONDS=60
+# 6. Wave-size A/B (MXU batch per eval = lanes x wave). PUCT recipe:
+# under gumbel_pcr the fast searches clamp the wave anyway and a
+# 64-wave 64-sim gumbel collapses sequential halving to one phase —
+# the A/B would change the algorithm, not just the batching.
+run wave16 BENCH_WAVE=16 BENCH_RECIPE=puct BENCH_SECONDS=45
+run wave64 BENCH_WAVE=64 BENCH_RECIPE=puct BENCH_SECONDS=45
 echo "sweep complete" >&2
