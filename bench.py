@@ -148,14 +148,32 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         bundle = baseline_preset(int(preset), run_name="bench")
         env_cfg, model_cfg = bundle["env"], bundle["model"]
         # Honor the A/B knobs in the preset path too (a silently
-        # ignored BENCH_WAVE would mislabel the measurement).
-        preset_mcts_updates = {
+        # ignored knob would mislabel the measurement).
+        preset_mcts_updates: dict = {
             "descent_gather": os.environ.get("BENCH_GATHER", "einsum")
         }
         if os.environ.get("BENCH_WAVE"):
             preset_mcts_updates["mcts_batch_size"] = int(
                 os.environ["BENCH_WAVE"]
             )
+        if os.environ.get("BENCH_FAST_SIMS"):
+            preset_mcts_updates["fast_simulations"] = int(
+                os.environ["BENCH_FAST_SIMS"]
+            )
+            preset_mcts_updates["full_search_prob"] = float(
+                os.environ.get("BENCH_FULL_PROB", "0.25")
+            )
+        preset_recipe = os.environ.get("BENCH_RECIPE")
+        if preset_recipe == "puct":
+            preset_mcts_updates["root_selection"] = "puct"
+            preset_mcts_updates.setdefault("fast_simulations", None)
+        elif preset_recipe == "gumbel_pcr":
+            preset_mcts_updates["root_selection"] = "gumbel"
+            preset_mcts_updates.setdefault(
+                "fast_simulations",
+                max(1, bundle["mcts"].max_simulations // 4),
+            )
+            preset_mcts_updates.setdefault("full_search_prob", 0.25)
         mcts_cfg = bundle["mcts"].model_copy(update=preset_mcts_updates)
         train_updates = {
             "BUFFER_CAPACITY": 10_000,

@@ -1,0 +1,184 @@
+"""Learning proof for the OVERLAPPED topology: the pipelined async
+loop (producer threads + replay-ratio-gated, double-buffered learner)
+doesn't just run — it learns.
+
+The round-3 learning A/Bs (benchmarks/learning_curve.py) drove the
+engine and trainer directly, synchronously. This harness trains the
+same 4x6/2-slot small-board world through the REAL `TrainingLoop` in
+overlapped mode — `ASYNC_ROLLOUTS` + `PIPELINE_LEARNER` + fused groups
++ 2 rollout streams + the flagship Gumbel+PCR search recipe — then
+scores the trained net against the untrained baseline with the same
+fixed greedy-PUCT evaluator the round-3 curves used.
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/async_learning_proof.py
+Env:    PROOF_STEPS=N (default 1500), PROOF_EVAL_GAMES=N (default 256)
+Writes benchmarks/async_learning_results.json.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "benchmarks"))
+
+from learning_curve import greedy_eval  # noqa: E402  (shared evaluator)
+
+from alphatriangle_tpu.config import (  # noqa: E402
+    AlphaTriangleMCTSConfig,
+    EnvConfig,
+    ModelConfig,
+    PersistenceConfig,
+    TrainConfig,
+    expected_other_features_dim,
+)
+from alphatriangle_tpu.mcts import BatchedMCTS  # noqa: E402
+from alphatriangle_tpu.training import (  # noqa: E402
+    LoopStatus,
+    TrainingLoop,
+    setup_training_components,
+)
+
+
+def main() -> int:
+    steps = int(os.environ.get("PROOF_STEPS", "1500"))
+    eval_games = int(os.environ.get("PROOF_EVAL_GAMES", "256"))
+
+    env_cfg = EnvConfig(
+        ROWS=4, COLS=6, PLAYABLE_RANGE_PER_ROW=[(0, 6)] * 4, NUM_SHAPE_SLOTS=2
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[16],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=1,
+        RESIDUAL_BLOCK_FILTERS=16,
+        USE_TRANSFORMER=False,
+        FC_DIMS_SHARED=[32],
+        POLICY_HEAD_DIMS=[32],
+        VALUE_HEAD_DIMS=[32],
+        NUM_VALUE_ATOMS=21,
+        VALUE_MIN=-5.0,
+        VALUE_MAX=30.0,
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+    )
+    # The measured flagship recipe at small-board scale (matches the
+    # winning LEARN_GUMBEL=1 LEARN_PCR=1 arm in BASELINE.md).
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=16,
+        max_depth=6,
+        mcts_batch_size=8,
+        root_selection="gumbel",
+        gumbel_m=8,
+        fast_simulations=4,
+    )
+    train_cfg = TrainConfig(
+        SELF_PLAY_BATCH_SIZE=32,
+        ROLLOUT_CHUNK_MOVES=4,
+        BATCH_SIZE=64,
+        BUFFER_CAPACITY=20_000,
+        MIN_BUFFER_SIZE_TO_TRAIN=512,
+        MAX_TRAINING_STEPS=steps,
+        WORKER_UPDATE_FREQ_STEPS=10,
+        LEARNING_RATE=1e-3,
+        N_STEP_RETURNS=3,
+        TEMPERATURE_ANNEAL_MOVES=8,
+        # The overlapped topology under test.
+        ASYNC_ROLLOUTS=True,
+        PIPELINE_LEARNER=True,
+        FUSED_LEARNER_STEPS=4,
+        NUM_SELF_PLAY_WORKERS=2,
+        REPLAY_RATIO=1.0,
+        AUTO_RESUME_LATEST=False,
+        CHECKPOINT_SAVE_FREQ_STEPS=100_000,  # not under test
+        RUN_NAME="async_proof",
+    )
+    root = Path(os.environ.get("PROOF_ROOT", "/tmp/async_proof"))
+    c = setup_training_components(
+        train_config=train_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=PersistenceConfig(
+            ROOT_DATA_DIR=str(root), RUN_NAME="async_proof"
+        ),
+        use_tensorboard=False,
+    )
+
+    # Fixed evaluator: greedy PUCT-16, 60-move games averaged over
+    # seeds 11 and 22 — EXACTLY learning_curve.py's run_eval, so this
+    # row is apples-to-apples with the round-3 curves in BASELINE.md.
+    eval_mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=16, max_depth=6, mcts_batch_size=8,
+        dirichlet_epsilon=0.0,
+    )
+
+    def evaluate(net) -> float:
+        mcts = BatchedMCTS(
+            c.env, c.extractor, net.model, eval_mcts_cfg, net.support
+        )
+        return float(
+            sum(
+                greedy_eval(c.env, net, mcts, eval_games, 60, s)
+                for s in (11, 22)
+            )
+            / 2
+        )
+
+    # Baseline = the SAME net the loop will train (seeded by
+    # TrainConfig.RANDOM_SEED), evaluated before any training — the
+    # before/after delta measures training, not an init lottery.
+    before = evaluate(c.net)
+    print(f"untrained greedy eval: {before:.2f}", flush=True)
+
+    t0 = time.time()
+    loop = TrainingLoop(c)
+    status = loop.run()
+    train_seconds = time.time() - t0
+    assert status == LoopStatus.COMPLETED, status
+    c.trainer.sync_to_network()
+
+    after = evaluate(c.net)
+    print(f"trained greedy eval: {after:.2f}", flush=True)
+
+    payload = {
+        "topology": "overlapped: pipelined learner + auto-chunk + "
+        "2 streams + fused groups + Gumbel+PCR",
+        "steps": loop.global_step,
+        "train_seconds": round(train_seconds, 1),
+        "steps_per_sec": round(loop.global_step / train_seconds, 2),
+        "episodes_played": loop.episodes_played,
+        "experiences": loop.experiences_added,
+        "achieved_replay_ratio": round(
+            loop._steps_this_run
+            * train_cfg.BATCH_SIZE
+            / max(loop.experiences_added, 1),
+            3,
+        ),
+        "tuned_chunk_moves": loop._tuned_chunk_moves,
+        "eval_games": eval_games,
+        "untrained_eval": round(before, 2),
+        "trained_eval": round(after, 2),
+        "improvement_pct": round(100 * (after - before) / max(before, 1e-9), 1),
+    }
+    out = REPO / "benchmarks" / "async_learning_results.json"
+    out.write_text(json.dumps(payload, indent=2))
+    print(json.dumps(payload))
+    c.stats.close()
+    c.checkpoints.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
