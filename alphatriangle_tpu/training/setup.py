@@ -40,23 +40,27 @@ def clamp_self_play_workers(requested: int) -> int:
     """Clamp rollout-stream count to the host + device budget.
 
     The reference clamps its Ray self-play actors to cores-2
-    (`alphatriangle/training/setup.py:106-151`). Streams here are
-    producer threads driving device-batched engines, so two budgets
-    apply: host threads (cores-2, the reference's rule — each stream
-    burns a core on harvest compaction) and device dispatch depth
-    (MAX_STREAMS_PER_DEVICE per local chip). Returns the effective
-    count, warning when it clamps.
+    (`alphatriangle/training/setup.py:106-151`) because its actors ARE
+    CPU-bound searchers. Streams here are producer threads driving
+    device-batched engines: on an accelerator host they spend their
+    lives blocked on device transfers (harvest compaction is light),
+    so the binding budget is device dispatch depth
+    (MAX_STREAMS_PER_DEVICE per local chip), not host cores — a 1-core
+    TPU VM frontend legitimately runs several streams. Only when the
+    "device" IS the host CPU does the reference's cores-2 rule apply
+    unchanged. Returns the effective count, warning when it clamps.
     """
     import jax
 
     cores = os.cpu_count() or 1
-    cap = max(
-        1,
-        min(
-            cores - 2 if cores > 2 else 1,
-            MAX_STREAMS_PER_DEVICE * jax.local_device_count(),
-        ),
-    )
+    device_cap = MAX_STREAMS_PER_DEVICE * jax.local_device_count()
+    if jax.default_backend() == "cpu":
+        # The "device" IS the host: reference rule, cores-2.
+        cap = max(1, min(cores - 2 if cores > 2 else 1, device_cap))
+    else:
+        # Accelerator host: threads are dispatch-bound, cores don't
+        # bind — the per-chip dispatch budget is the whole cap.
+        cap = max(1, device_cap)
     if requested > cap:
         logger.warning(
             "NUM_SELF_PLAY_WORKERS=%d exceeds this host's budget "

@@ -164,6 +164,12 @@ def run_bench(smoke: bool, seconds: float) -> dict:
                 os.environ.get("BENCH_FULL_PROB", "0.25")
             )
         preset_recipe = os.environ.get("BENCH_RECIPE")
+        if preset_recipe not in (None, "", "puct", "gumbel_pcr"):
+            raise SystemExit(
+                f"Unknown BENCH_RECIPE={preset_recipe!r} "
+                "(valid: puct, gumbel_pcr) — refusing to run a "
+                "mislabeled measurement."
+            )
         if preset_recipe == "puct":
             preset_mcts_updates["root_selection"] = "puct"
             preset_mcts_updates.setdefault("fast_simulations", None)
@@ -242,6 +248,11 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         recipe = os.environ.get(
             "BENCH_RECIPE", "gumbel_pcr" if scale == "flagship" else "puct"
         )
+        if recipe not in ("puct", "gumbel_pcr"):
+            raise SystemExit(
+                f"Unknown BENCH_RECIPE={recipe!r} (valid: puct, "
+                "gumbel_pcr) — refusing to run a mislabeled measurement."
+            )
         if recipe == "gumbel_pcr":
             # The flagship training recipe: Gumbel root + playout cap
             # randomization — the measured-best learning arm (+11%

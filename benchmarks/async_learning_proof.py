@@ -10,7 +10,9 @@ overlapped mode — `ASYNC_ROLLOUTS` + `PIPELINE_LEARNER` + fused groups
 scores the trained net against the untrained baseline with the same
 fixed greedy-PUCT evaluator the round-3 curves used.
 
-Usage:  JAX_PLATFORMS=cpu python benchmarks/async_learning_proof.py
+Usage:  python benchmarks/async_learning_proof.py   (CPU harness: the
+        platform is forced to CPU — like learning_curve.py — so the
+        numbers stay comparable across hosts)
 Env:    PROOF_STEPS=N (default 1500), PROOF_EVAL_GAMES=N (default 256)
 Writes benchmarks/async_learning_results.json.
 """
@@ -21,8 +23,6 @@ import sys
 import time
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -32,15 +32,18 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "benchmarks"))
 
-from learning_curve import greedy_eval  # noqa: E402  (shared evaluator)
+# Shared with learning_curve.py: evaluator AND world configs, so this
+# row stays locked to the round-3 curves' yardstick.
+from learning_curve import (  # noqa: E402
+    curve_model,
+    greedy_eval,
+    small_board_env,
+)
 
 from alphatriangle_tpu.config import (  # noqa: E402
     AlphaTriangleMCTSConfig,
-    EnvConfig,
-    ModelConfig,
     PersistenceConfig,
     TrainConfig,
-    expected_other_features_dim,
 )
 from alphatriangle_tpu.mcts import BatchedMCTS  # noqa: E402
 from alphatriangle_tpu.training import (  # noqa: E402
@@ -54,25 +57,8 @@ def main() -> int:
     steps = int(os.environ.get("PROOF_STEPS", "1500"))
     eval_games = int(os.environ.get("PROOF_EVAL_GAMES", "256"))
 
-    env_cfg = EnvConfig(
-        ROWS=4, COLS=6, PLAYABLE_RANGE_PER_ROW=[(0, 6)] * 4, NUM_SHAPE_SLOTS=2
-    )
-    model_cfg = ModelConfig(
-        GRID_INPUT_CHANNELS=1,
-        CONV_FILTERS=[16],
-        CONV_KERNEL_SIZES=[3],
-        CONV_STRIDES=[1],
-        NUM_RESIDUAL_BLOCKS=1,
-        RESIDUAL_BLOCK_FILTERS=16,
-        USE_TRANSFORMER=False,
-        FC_DIMS_SHARED=[32],
-        POLICY_HEAD_DIMS=[32],
-        VALUE_HEAD_DIMS=[32],
-        NUM_VALUE_ATOMS=21,
-        VALUE_MIN=-5.0,
-        VALUE_MAX=30.0,
-        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
-    )
+    env_cfg = small_board_env()
+    model_cfg = curve_model(env_cfg)
     # The measured flagship recipe at small-board scale (matches the
     # winning LEARN_GUMBEL=1 LEARN_PCR=1 arm in BASELINE.md).
     mcts_cfg = AlphaTriangleMCTSConfig(

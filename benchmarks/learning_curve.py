@@ -42,25 +42,23 @@ from alphatriangle_tpu.nn.network import NeuralNetwork
 from alphatriangle_tpu.rl import ExperienceBuffer, SelfPlayEngine, Trainer
 
 
-def build():
-    # LEARN_BOARD=small: 4x6/2-slot — a meaningfully larger decision
-    # space than the luck-bounded 3x4 (action_dim 48 vs 12, two-slot
-    # choice), still CPU-tractable.
-    if os.environ.get("LEARN_BOARD") == "small":
-        env_cfg = EnvConfig(
-            ROWS=4,
-            COLS=6,
-            PLAYABLE_RANGE_PER_ROW=[(0, 6)] * 4,
-            NUM_SHAPE_SLOTS=2,
-        )
-    else:
-        env_cfg = EnvConfig(
-            ROWS=3,
-            COLS=4,
-            PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
-            NUM_SHAPE_SLOTS=1,
-        )
-    model_cfg = ModelConfig(
+def small_board_env() -> EnvConfig:
+    """The 4x6/2-slot 'small' learning board — a meaningfully larger
+    decision space than the luck-bounded 3x4 (action_dim 48 vs 12,
+    two-slot choice), still CPU-tractable. Shared with
+    `async_learning_proof.py` so its BASELINE.md row stays
+    apples-to-apples with the curves measured here."""
+    return EnvConfig(
+        ROWS=4,
+        COLS=6,
+        PLAYABLE_RANGE_PER_ROW=[(0, 6)] * 4,
+        NUM_SHAPE_SLOTS=2,
+    )
+
+
+def curve_model(env_cfg: EnvConfig) -> ModelConfig:
+    """The learning-harness net (shared with async_learning_proof.py)."""
+    return ModelConfig(
         GRID_INPUT_CHANNELS=1,
         CONV_FILTERS=[16],
         CONV_KERNEL_SIZES=[3],
@@ -76,6 +74,19 @@ def build():
         VALUE_MAX=30.0,
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
     )
+
+
+def build():
+    if os.environ.get("LEARN_BOARD") == "small":
+        env_cfg = small_board_env()
+    else:
+        env_cfg = EnvConfig(
+            ROWS=3,
+            COLS=4,
+            PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+            NUM_SHAPE_SLOTS=1,
+        )
+    model_cfg = curve_model(env_cfg)
     mcts_cfg = AlphaTriangleMCTSConfig(
         max_simulations=16,
         max_depth=6,

@@ -334,12 +334,19 @@ class TestAsyncLoop:
         monkeypatch.setattr(os_mod, "cpu_count", lambda: 4)
         assert clamp_self_play_workers(1) == 1
         assert clamp_self_play_workers(2) == 2
-        assert clamp_self_play_workers(8) == 2  # cores-2 wins
+        assert clamp_self_play_workers(8) == 2  # cores-2 wins (cpu backend)
         monkeypatch.setattr(os_mod, "cpu_count", lambda: 64)
         import jax as jax_mod
 
         cap = 4 * jax_mod.local_device_count()
         assert clamp_self_play_workers(10_000) == min(62, cap)
+        # Accelerator host: producer threads are dispatch-bound, so a
+        # 1-core TPU VM frontend still gets the full per-device budget.
+        monkeypatch.setattr(os_mod, "cpu_count", lambda: 1)
+        assert clamp_self_play_workers(8) == 1  # cpu backend: host-bound
+        monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+        assert clamp_self_play_workers(8) == 8
+        assert clamp_self_play_workers(10_000) == cap
 
     def test_producer_error_surfaces(self, tmp_path, tiny_world_configs):
         """A crash in the producer thread fails the run instead of
