@@ -9,7 +9,18 @@ run() {
   label="$1"; shift
   echo "=== $label ===" >&2
   line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 timeout 900 python bench.py)
+  if [ -z "$line" ]; then
+    echo "$label: bench produced no JSON (killed?); aborting sweep" >&2
+    exit 1
+  fi
   echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
+  # A section that fell back to CPU means the chip wedged mid-sweep:
+  # every further section would burn its probe budget and record
+  # CPU-scale numbers under a TPU label. Stop; rerun in a new window.
+  if ! printf '%s' "$line" | grep -q '"backend": "tpu"'; then
+    echo "$label: backend != tpu (chip wedged?); aborting sweep" >&2
+    exit 1
+  fi
 }
 # 1. Flagship, new default recipe (gumbel+PCR) + pipelined overlap + MFU.
 run flagship_gumbel_pcr BENCH_SECONDS=75
