@@ -67,6 +67,14 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         "-gated learner (see --replay-ratio).",
     )
     p.add_argument(
+        "--device-replay",
+        choices=["auto", "on", "off"],
+        default=None,
+        help="Device-resident replay ring (auto = on for single-chip "
+        "accelerator runs): rollouts scatter experiences into device "
+        "HBM and batches are gathered there from sampled indices.",
+    )
+    p.add_argument(
         "--workers",
         type=int,
         default=None,
@@ -190,6 +198,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["FUSED_LEARNER_STEPS"] = args.fused_learner_steps
     if args.async_rollouts:
         overrides["ASYNC_ROLLOUTS"] = True
+    if args.device_replay is not None:
+        overrides["DEVICE_REPLAY"] = args.device_replay
     if args.workers is not None:
         overrides["NUM_SELF_PLAY_WORKERS"] = args.workers
     if args.replay_ratio is not None:

@@ -192,18 +192,14 @@ class ExperienceBuffer:
         frac = min(1.0, max(0.0, train_step / self.beta_anneal_steps))
         return self.beta_initial + frac * (self.beta_final - self.beta_initial)
 
-    def sample(
-        self, batch_size: int, current_train_step: int | None = None
-    ) -> DenseSample | None:
-        """Sample a dense training batch.
-
-        Returns None until `is_ready()` (reference `buffer.py:85-92`).
-        Under PER, `current_train_step` is required for β annealing
-        (reference `buffer.py:96-101`).
-        """
+    def _sample_indices(
+        self, batch_size: int, current_train_step: int | None
+    ) -> tuple[np.ndarray, np.ndarray] | None:
+        """Shared slot-sampling math: (slots, IS weights) or None until
+        ready. Stratified proportional PER with β-annealed, max-
+        normalized importance weights (reference `buffer.py:96-150`)."""
         if not self.is_ready() or batch_size > self._size:
             return None
-        assert self._storage is not None
         if self.use_per:
             if current_train_step is None:
                 raise ValueError(
@@ -219,6 +215,22 @@ class ExperienceBuffer:
         else:
             slots = self._rng.integers(0, self._size, size=batch_size)
             weights = np.ones(batch_size, dtype=np.float32)
+        return slots, weights
+
+    def sample(
+        self, batch_size: int, current_train_step: int | None = None
+    ) -> DenseSample | None:
+        """Sample a dense training batch.
+
+        Returns None until `is_ready()` (reference `buffer.py:85-92`).
+        Under PER, `current_train_step` is required for β annealing
+        (reference `buffer.py:96-101`).
+        """
+        sampled = self._sample_indices(batch_size, current_train_step)
+        if sampled is None:
+            return None
+        slots, weights = sampled
+        assert self._storage is not None
         batch: DenseBatch = {
             "grid": self._storage["grid"][slots].astype(np.float32),
             "other_features": self._storage["other_features"][slots],

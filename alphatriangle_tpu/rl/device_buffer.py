@@ -1,0 +1,298 @@
+"""Device-resident experience replay: the zero-copy training data path.
+
+The host replay buffer (`rl/buffer.py`) mirrors the reference's
+topology (`alphatriangle/rl/core/buffer.py:25-195`): experiences are
+fetched from the rollout device program to host memory, stored in a
+NumPy ring, and every sampled batch is re-uploaded for training. On a
+chip whose host link is slow relative to compute — PCIe on a real TPU
+VM, a network tunnel in this dev environment — that round trip IS the
+learner bottleneck: at flagship scale one fused 16-step group stages
+~8.5 MB of batches and the measured learner throughput pinned to the
+link bandwidth, not the MXU (BENCH r4: 7.9 steps/s, 0.4% MFU).
+
+`DeviceReplayBuffer` keeps the ring in device HBM instead:
+
+- **Ingest** is one jitted scatter: the rollout chunk's dense masked
+  experience outputs (still device arrays — `SelfPlayEngine.
+  play_moves_device` never fetches them) are flattened, validated
+  (finiteness + policy-distribution checks, absorbing the role of
+  `SelfPlayResult`'s validator) and ring-written at positions derived
+  from a running cursor via a prefix-sum over the validity mask.
+  Invalid rows land in a trash slot at index `capacity`. Only the
+  *count* of rows written returns to the host (one scalar), which is
+  all the host-side PER SumTree needs: rows occupy slots
+  `[cursor, cursor+count) % capacity` in order, and new rows get
+  max-priority init exactly like the host buffer.
+- **Sampling** stays host-side (the SumTree is cheap and sequential —
+  SURVEY.md §7 "PER on host vs device") but returns only slot
+  *indices* and IS weights; the trainer gathers the actual rows on
+  device (`Trainer.train_steps_from`), so a fused K-step group uploads
+  K*B int32 indices (~16 KB) instead of K batches (~8.5 MB).
+- **Priorities** update from the TD errors the trainer already fetches
+  (K*B float32 — small), identical to the host path.
+- **Persistence** round-trips through the same snapshot dict as the
+  host buffer (one bulk fetch per buffer spill — checkpoints are rare)
+  so `.npz` spills are interchangeable between the two buffer kinds
+  and a run can resume from either.
+
+Storage dtypes match the host ring: grid int8 (cells are exactly
+{-1,0,1}), everything else float32. The gather casts grid back to
+float32, so a batch sampled from the device ring is bit-identical to
+the same rows sampled from the host ring.
+
+Single-device, single-process only (gated in `training/setup.py`):
+the ring lives on one chip. The multi-chip extension — shard the ring
+over the dp axis, each device ingesting its own streams' rollouts —
+is a sharding annotation away but unneeded at the flagship scale
+(reference trains on ONE device; SURVEY.md §2c).
+
+CPU-backend caveat (DEVICE_REPLAY="on" there is a test/dev mode):
+XLA:CPU's *async dispatch* deadlocks when one thread blocks on an
+in-flight program while another thread enqueues programs sharing its
+buffers — reproduced with a producer rollout chunk + consumer ingest
+of its payload from two threads, flagship-size programs only (both
+fetches hang forever; tiny programs slip through). The fix is
+`jax.config.update("jax_cpu_enable_async_dispatch", False)` BEFORE the
+CPU client is created (the flag is latched at client construction —
+setting it here in the constructor is provably too late). The runner
+(`training/runner.py`) applies it at entry when DEVICE_REPLAY="on";
+tests apply it in conftest. The TPU backend's device-FIFO dispatch
+model is unaffected.
+"""
+
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.train_config import TrainConfig
+from .buffer import ExperienceBuffer
+
+logger = logging.getLogger(__name__)
+
+# Canonical field order for experience row blocks (the key names the
+# rollout program emits for its `mat`/`flush` outputs).
+_BLOCK_FIELDS = ("grid", "other", "policy", "ret", "pw")
+
+
+class DeviceReplayBuffer(ExperienceBuffer):
+    """Uniform/PER replay whose ring storage lives in device HBM.
+
+    Subclasses the host buffer for everything link-independent
+    (readiness, beta annealing, priority updates, SumTree sampling
+    math); replaces storage reads/writes with jitted device ops.
+    """
+
+    is_device = True
+
+    def __init__(
+        self,
+        config: TrainConfig,
+        grid_shape: tuple[int, int, int],
+        other_dim: int,
+        action_dim: int,
+        seed: int | None = None,
+    ):
+        super().__init__(config, seed=seed, action_dim=action_dim)
+        cap = self.capacity
+        # One trash row at index `cap` absorbs invalid-row scatters.
+        self.storage: dict[str, jax.Array] = {
+            "grid": jnp.zeros((cap + 1, *grid_shape), jnp.int8),
+            "other_features": jnp.zeros((cap + 1, other_dim), jnp.float32),
+            "policy_target": jnp.zeros((cap + 1, action_dim), jnp.float32),
+            "value_target": jnp.zeros(cap + 1, jnp.float32),
+            "policy_weight": jnp.ones(cap + 1, jnp.float32),
+        }
+        self._grid_shape = grid_shape
+        self._other_dim = other_dim
+        self._ingest_jit = jax.jit(self._ingest_impl, donate_argnums=(0,))
+
+    # --- device ingest ----------------------------------------------------
+
+    def _ingest_impl(
+        self,
+        storage: dict[str, jax.Array],
+        cursor: jax.Array,
+        blocks: tuple[dict[str, jax.Array], ...],
+    ):
+        """Flatten + validate + ring-scatter experience blocks.
+
+        Each block holds arrays with arbitrary leading dims (the chunk
+        program's (T,B) matured and (T,B,n) flushed outputs) plus a
+        boolean `mask` over those leading dims. Rows are written in
+        block order, leading-dims-major — the same order the host path
+        produces via boolean indexing, so the two paths fill identical
+        slots with identical rows.
+        """
+        cap = self.capacity
+
+        def flat(block: dict[str, jax.Array], f: str) -> jax.Array:
+            lead = block["mask"].shape
+            v = block[f]
+            return v.reshape(-1, *v.shape[len(lead) :])
+
+        rows = {
+            f: jnp.concatenate([flat(b, f) for b in blocks])
+            for f in _BLOCK_FIELDS
+        }
+        mask = jnp.concatenate([b["mask"].reshape(-1) for b in blocks])
+        # Validation absorbed from SelfPlayResult's validator + the host
+        # buffer's finite filter (rl/types.py:78-85, buffer.py:120-128).
+        valid = (
+            mask
+            & jnp.isfinite(rows["grid"]).all(axis=(1, 2, 3))
+            & jnp.isfinite(rows["other"]).all(axis=1)
+            & jnp.isfinite(rows["policy"]).all(axis=1)
+            & jnp.isfinite(rows["ret"])
+            & (jnp.abs(rows["policy"].sum(axis=1) - 1.0) < 1e-3)
+        )
+        offsets = jnp.cumsum(valid.astype(jnp.int32)) - 1
+        count = valid.sum(dtype=jnp.int32)
+        # A single ingest larger than the ring keeps only the newest
+        # `cap` rows — the older ones would be overwritten by the wrap
+        # anyway, and dropping them guarantees distinct scatter slots,
+        # making last-write-wins deterministic (`.at[pos].set` with
+        # duplicate indices has an unspecified winner). The cursor still
+        # advances by the full count, matching the host ring.
+        keep = valid & (offsets >= count - cap)
+        pos = jnp.where(keep, (cursor + offsets) % cap, cap)
+        new_storage = {
+            "grid": storage["grid"].at[pos].set(rows["grid"].astype(jnp.int8)),
+            "other_features": storage["other_features"]
+            .at[pos]
+            .set(rows["other"].astype(jnp.float32)),
+            "policy_target": storage["policy_target"]
+            .at[pos]
+            .set(rows["policy"].astype(jnp.float32)),
+            "value_target": storage["value_target"]
+            .at[pos]
+            .set(rows["ret"].astype(jnp.float32)),
+            "policy_weight": storage["policy_weight"]
+            .at[pos]
+            .set(rows["pw"].astype(jnp.float32)),
+        }
+        return new_storage, (cursor + count) % cap, count
+
+    def _ingest_blocks(
+        self, blocks: "tuple[dict[str, Any], ...]"
+    ) -> tuple[int, np.ndarray]:
+        """Run the jitted ingest; returns (rows written, their slots)."""
+        self.storage, _, count_dev = self._ingest_jit(
+            self.storage, jnp.int32(self._pos), blocks
+        )
+        count = int(count_dev)  # the one blocking scalar fetch
+        slots = (self._pos + np.arange(count)) % self.capacity
+        if self.tree is not None and count:
+            self.tree.update_batch(
+                slots, np.full(count, self.tree.max_priority, dtype=np.float64)
+            )
+            self.tree.data_pointer = int((self._pos + count) % self.capacity)
+            self.tree.n_entries = min(self._size + count, self.capacity)
+        self._pos = int((self._pos + count) % self.capacity)
+        self._size = min(self._size + count, self.capacity)
+        return count, slots
+
+    def ingest_payload(self, payload: dict[str, Any]) -> int:
+        """Fold one rollout chunk's device-resident experience outputs
+        (`SelfPlayEngine.play_moves_device`) into the ring. Returns the
+        number of rows written — the only thing fetched."""
+        return self._ingest_blocks((payload["mat"], payload["flush"]))[0]
+
+    def add_dense(
+        self,
+        grid: np.ndarray,
+        other_features: np.ndarray,
+        policy_target: np.ndarray,
+        value_target: np.ndarray,
+        policy_weight: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Host-array insert (restore path, tests, host-side generators).
+
+        Same contract as the host buffer's `add_dense`, via one upload
+        + the shared ingest program. Note the device path additionally
+        enforces the policy-distribution check (the validator layer the
+        device path absorbs), which the host buffer leaves to
+        `SelfPlayResult`.
+        """
+        grid = np.asarray(grid, dtype=np.float32)
+        k = grid.shape[0]
+        if k == 0:
+            return np.zeros(0, dtype=np.int64)
+        block = {
+            "grid": jnp.asarray(grid),
+            "other": jnp.asarray(other_features, dtype=jnp.float32),
+            "policy": jnp.asarray(policy_target, dtype=jnp.float32),
+            "ret": jnp.asarray(
+                np.asarray(value_target, dtype=np.float32).reshape(-1)
+            ),
+            "pw": jnp.asarray(
+                np.ones(k, np.float32)
+                if policy_weight is None
+                else np.asarray(policy_weight, dtype=np.float32).reshape(-1)
+            ),
+            "mask": jnp.ones(k, bool),
+        }
+        count, slots = self._ingest_blocks((block,))
+        if count < k:
+            logger.warning(
+                "DeviceReplayBuffer: dropped %d invalid rows of %d on add.",
+                k - count,
+                k,
+            )
+        return slots.astype(np.int64)
+
+    # --- sampling ---------------------------------------------------------
+
+    def sample(
+        self, batch_size: int, current_train_step: int | None = None
+    ) -> "dict[str, np.ndarray] | None":
+        """Sample slot indices + IS weights (no data movement).
+
+        Returns {"indices", "weights"} — the trainer gathers the rows
+        on device (`Trainer.train_steps_from`). The sampling math is
+        the parent's `_sample_indices` (shared, not duplicated).
+        """
+        sampled = self._sample_indices(batch_size, current_train_step)
+        if sampled is None:
+            return None
+        slots, weights = sampled
+        return {"indices": slots.astype(np.int64), "weights": weights}
+
+    # --- persistence ------------------------------------------------------
+
+    def get_state(self) -> dict[str, Any]:
+        """Same snapshot dict as the host buffer (one bulk fetch)."""
+        state: dict[str, Any] = {
+            "pos": self._pos,
+            "size": self._size,
+            "storage": None,
+            "priorities": None,
+        }
+        if self._size > 0:
+            host = jax.device_get(self.storage)
+            state["storage"] = {
+                k: np.asarray(v[: self._size]).copy() for k, v in host.items()
+            }
+        if self.tree is not None and self._size > 0:
+            leaves = np.arange(self._size) + self.tree._cap2
+            state["priorities"] = self.tree.tree[leaves].copy()
+        return state
+
+    def set_state(self, state: dict[str, Any]) -> None:
+        """Restore a snapshot (host- or device-buffer produced): let the
+        parent rebuild its host ring + SumTree, then upload the ring."""
+        super().set_state(state)
+        if self._storage is None:
+            return
+        host = {
+            k: np.zeros(
+                (self.capacity + 1, *v.shape[1:]), dtype=self.storage[k].dtype
+            )
+            for k, v in self._storage.items()
+        }
+        for k, v in self._storage.items():
+            host[k][: self.capacity] = v
+        self.storage = jax.device_put(host)
+        self._storage = None  # free the host copy; device ring is truth

@@ -402,8 +402,18 @@ class SelfPlayEngine:
         """Current (device-resident) batched game states."""
         return self._carry.env
 
-    def play_chunk(self, num_moves: int | None = None) -> None:
-        """Advance every game `num_moves` moves in ONE jitted dispatch."""
+    def play_chunk(
+        self, num_moves: int | None = None, fetch_experiences: bool = True
+    ) -> "dict | None":
+        """Advance every game `num_moves` moves in ONE jitted dispatch.
+
+        `fetch_experiences=False` is the device-replay path: the dense
+        masked experience outputs (the overwhelming bulk of a chunk's
+        payload) are NOT transferred — they return as device arrays for
+        `DeviceReplayBuffer.ingest_payload` to scatter into the
+        on-device ring; only episode stats + diagnostics (KBs) are
+        fetched. Returns that device payload, or None in fetch mode.
+        """
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         version = self.net.weights_version
         self._min_weights_version = (
@@ -414,7 +424,12 @@ class SelfPlayEngine:
         self._carry, outputs = self._chunk_fn(t)(
             self.net.variables, self._carry, jnp.int32(version)
         )
-        host = jax.device_get(outputs)  # the one transfer per chunk
+        payload: dict | None = None
+        if fetch_experiences:
+            host = jax.device_get(outputs)  # the one transfer per chunk
+        else:
+            payload = {"mat": outputs.pop("mat"), "flush": outputs.pop("flush")}
+            host = jax.device_get(outputs)  # stats + trace only (small)
         # Under playout cap randomization the per-move sim count varies;
         # the trace records what actually ran.
         self._total_simulations += (
@@ -422,7 +437,18 @@ class SelfPlayEngine:
         )
 
         self.last_trace = host["trace"]
-        mat, flush, episode = host["mat"], host["flush"], host["episode"]
+        episode = host["episode"]
+        self._fold_episode_stats(episode)
+        sentinels = int(host["sentinel_live"].sum())
+        if sentinels:
+            logger.warning(
+                "SelfPlay: %d zero-visit sentinel actions on LIVE games "
+                "(clamped to action 0) — root search produced no visits.",
+                sentinels,
+            )
+        if not fetch_experiences:
+            return payload
+        mat, flush = host["mat"], host["flush"]
         mmask = mat["mask"]  # (T, B)
         if mmask.any():
             self._out.append(
@@ -445,6 +471,10 @@ class SelfPlayEngine:
                     flush["pw"][fmask].astype(np.float32),
                 )
             )
+        return None
+
+    def _fold_episode_stats(self, episode: dict) -> None:
+        """Accumulate finished-episode stats from one chunk's outputs."""
         ending = episode["ending"]  # (T, B)
         if ending.any():
             self._episode_scores.extend(
@@ -458,13 +488,6 @@ class SelfPlayEngine:
             )
             self._episodes_played += int(ending.sum())
             self._episodes_truncated += int(episode["truncated"][ending].sum())
-        sentinels = int(host["sentinel_live"].sum())
-        if sentinels:
-            logger.warning(
-                "SelfPlay: %d zero-visit sentinel actions on LIVE games "
-                "(clamped to action 0) — root search produced no visits.",
-                sentinels,
-            )
 
     def play_move(self) -> None:
         """Advance every game by one move (single-move chunk)."""
@@ -474,6 +497,16 @@ class SelfPlayEngine:
         """Advance all games `num_moves` moves and harvest experiences."""
         self.play_chunk(num_moves)
         return self.harvest()
+
+    def play_moves_device(
+        self, num_moves: int
+    ) -> tuple[SelfPlayResult, dict]:
+        """Device-replay variant of `play_moves`: experiences never
+        leave the device. Returns (stats-only harvest, device payload
+        for `DeviceReplayBuffer.ingest_payload`)."""
+        payload = self.play_chunk(num_moves, fetch_experiences=False)
+        assert payload is not None
+        return self.harvest(), payload
 
     def harvest(self) -> SelfPlayResult:
         """Collect emitted experiences + episode stats since last call."""

@@ -220,6 +220,13 @@ class Trainer:
             donate_argnums=(0,),
         )
         self._stacked_shard = stacked_shard
+        # Device-buffer path (rl/device_buffer.py): batches are gathered
+        # ON DEVICE from the replay ring by sampled indices — the fused
+        # group's host->device traffic shrinks from K full batches to
+        # K*B int32 indices. One compiled program per distinct K.
+        self._from_fn = jax.jit(
+            self._train_steps_from_impl, donate_argnums=(0,)
+        )
         # Keep state resident on the mesh (replicated, or TP-sharded
         # over the mdl axis when it is wider than 1).
         self.state = jax.device_put(self.state, state_shard)
@@ -351,6 +358,22 @@ class Trainer:
         )
         return state, metrics_k, td_k
 
+    def _train_steps_from_impl(self, state: TrainState, storage, idx, weights):
+        """K fused steps whose batches are gathered from the device
+        replay ring: `idx` is (K, B) int32 slot indices, `weights` the
+        matching (K, B) IS weights. Bit-identical to `_train_steps_impl`
+        on the same rows (the grid int8->float32 cast reproduces the
+        host ring's storage round trip exactly)."""
+        stacked: DenseBatch = {
+            "grid": storage["grid"][idx].astype(jnp.float32),
+            "other_features": storage["other_features"][idx],
+            "policy_target": storage["policy_target"][idx],
+            "value_target": storage["value_target"][idx],
+            "policy_weight": storage["policy_weight"][idx],
+            "weights": weights,
+        }
+        return self._train_steps_impl(state, stacked)
+
     # --- host API ---------------------------------------------------------
 
     @staticmethod
@@ -470,6 +493,48 @@ class Trainer:
         self._host_step += handle["k"]
         return handle
 
+    def train_steps_from(
+        self, buffer, samples: "list[dict]"
+    ) -> list[tuple[dict[str, float], np.ndarray]]:
+        """K fused steps sampled from a `DeviceReplayBuffer`: upload
+        only indices + IS weights; rows are gathered on device."""
+        handle = self.train_steps_from_begin(buffer, samples)
+        if handle is None:
+            return []
+        return self.train_steps_finish(handle)
+
+    def train_steps_from_begin(
+        self, buffer, samples: "list[dict]"
+    ) -> dict | None:
+        """Pipelined dispatch of a device-gathered fused group.
+
+        `samples` are `DeviceReplayBuffer.sample` outputs ({"indices",
+        "weights"}). Single-process only — the ring lives on one chip
+        (gated in training/setup.py). Same handle/fetch contract as
+        `train_steps_begin`/`train_steps_finish`.
+        """
+        if not samples:
+            return None
+        idx = np.stack(
+            [np.asarray(s["indices"], dtype=np.int32) for s in samples]
+        )
+        weights = np.stack(
+            [np.asarray(s["weights"], dtype=np.float32) for s in samples]
+        )
+        self.state, metrics_k, td_k = self._from_fn(
+            self.state, buffer.storage, idx, weights
+        )
+        handle = {
+            "k": len(samples),
+            "metrics": metrics_k,
+            "td": td_k,
+            # The scan stacks outputs even at K=1; tells finish so.
+            "stacked": True,
+            "start_step": self._host_step,
+        }
+        self._host_step += len(samples)
+        return handle
+
     def train_steps_finish(
         self, handle: dict
     ) -> list[tuple[dict[str, float], np.ndarray]]:
@@ -484,9 +549,11 @@ class Trainer:
             (metrics_k, td_k if jax.process_count() == 1 else None)
         )
         if td_host is None:
-            td_host = local_rows(td_k, axis=1 if k > 1 else 0)
+            td_host = local_rows(
+                td_k, axis=1 if (k > 1 or handle.get("stacked")) else 0
+            )
         td_host = np.asarray(td_host)
-        if k == 1:
+        if k == 1 and not handle.get("stacked"):
             host_metrics_k = {
                 key: np.asarray(v)[None] for key, v in host_metrics_k.items()
             }

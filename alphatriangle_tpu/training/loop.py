@@ -53,6 +53,12 @@ class TrainingLoop:
         self.c = components
         self.cfg = components.train_config
         self.stop_event = threading.Event()
+        # Device-resident replay (rl/device_buffer.py): rollout payloads
+        # stay on device and training batches are gathered there; the
+        # loop moves only indices, counts and metrics over the link.
+        self._device_replay = bool(
+            getattr(components.buffer, "is_device", False)
+        )
 
         self.global_step = 0
         self.episodes_played = 0
@@ -104,26 +110,41 @@ class TrainingLoop:
 
     # --- iteration pieces -------------------------------------------------
 
+    def _play_rollout(self, engine, moves: int) -> tuple:
+        """One rollout chunk on `engine`: (stats result, device payload
+        or None) — the device-replay branch expressed once."""
+        if self._device_replay:
+            return engine.play_moves_device(moves)
+        return engine.play_moves(moves), None
+
     def _process_rollout(self) -> int:
         """One rollout chunk -> buffer. Returns experiences added."""
-        result = self.c.self_play.play_moves(self.cfg.ROLLOUT_CHUNK_MOVES)
-        return self._fold_result(result)
+        result, payload = self._play_rollout(
+            self.c.self_play, self.cfg.ROLLOUT_CHUNK_MOVES
+        )
+        return self._fold_result(result, payload=payload)
 
-    def _fold_result(self, result, trace=None) -> int:
+    def _fold_result(self, result, trace=None, payload=None) -> int:
         """Fold one self-play harvest into the buffer + metrics.
 
         `trace` is the producing engine's per-chunk diagnostics; when
         None (sync mode, single producer) the primary engine's
-        `last_trace` is read directly.
+        `last_trace` is read directly. `payload` is the device-resident
+        experience block in device-replay mode (scattered into the
+        on-device ring; `result` then carries stats only).
         """
         c = self.c
-        c.buffer.add_dense(
-            result.grid,
-            result.other_features,
-            result.policy_target,
-            result.value_target,
-            policy_weight=result.policy_weight,
-        )
+        if payload is not None:
+            added = c.buffer.ingest_payload(payload)
+        else:
+            c.buffer.add_dense(
+                result.grid,
+                result.other_features,
+                result.policy_target,
+                result.value_target,
+                policy_weight=result.policy_weight,
+            )
+            added = result.num_experiences
         self.episodes_played += result.num_episodes
         self.total_simulations += result.total_simulations
         step = self.global_step
@@ -133,7 +154,7 @@ class TrainingLoop:
             ),
             RawMetricEvent(
                 name="SelfPlay/Experiences_Per_Chunk",
-                value=result.num_experiences,
+                value=added,
                 global_step=step,
             ),
         ]
@@ -217,8 +238,8 @@ class TrainingLoop:
                     )
                 )
         c.stats.log_batch_events(events)
-        self.experiences_added += result.num_experiences
-        return result.num_experiences
+        self.experiences_added += added
+        return added
 
     def _record_step(self, metrics: dict, td_errors, indices, step: int) -> None:
         """Per-learner-step bookkeeping: priorities, counters, events.
@@ -346,7 +367,19 @@ class TrainingLoop:
                 break
             prev_step = self.global_step
             with self.profile.phase("train"):
-                if len(samples) == k and k > 1:
+                if self._device_replay:
+                    if len(samples) == k and k > 1:
+                        outs = c.trainer.train_steps_from(c.buffer, samples)
+                    else:
+                        # Tail groups ride K=1 programs one at a time
+                        # (a fused program per distinct K would
+                        # recompile), matching the host-path guard.
+                        outs = []
+                        for s in samples:
+                            outs.extend(
+                                c.trainer.train_steps_from(c.buffer, [s])
+                            )
+                elif len(samples) == k and k > 1:
                     outs = c.trainer.train_steps(
                         [s["batch"] for s in samples]
                     )
@@ -581,8 +614,8 @@ class TrainingLoop:
                 # uncontended measurement) — a producer-side sample
                 # would include the other streams' queued programs.
                 with self.profile.phase("rollout"):
-                    result = engine.play_moves(moves)
-                item = (result, engine.last_trace)
+                    result, payload = self._play_rollout(engine, moves)
+                item = (result, engine.last_trace, payload)
                 # Backpressure wait, timed per stream: persistent high
                 # wait here means the consumer (fold + learner) is the
                 # bottleneck, not self-play.
@@ -636,7 +669,22 @@ class TrainingLoop:
         if not samples:
             return False
         with self.profile.phase("dispatch"):
-            if len(samples) == k and k > 1:
+            if self._device_replay:
+                if len(samples) == k and k > 1:
+                    handle = c.trainer.train_steps_from_begin(
+                        c.buffer, samples
+                    )
+                    groups = [(handle, samples)] if handle is not None else []
+                else:
+                    groups = []
+                    for s in samples:
+                        handle = c.trainer.train_steps_from_begin(
+                            c.buffer, [s]
+                        )
+                        if handle is None:
+                            break
+                        groups.append((handle, [s]))
+            elif len(samples) == k and k > 1:
                 handle = c.trainer.train_steps_begin(
                     [s["batch"] for s in samples]
                 )
@@ -738,14 +786,17 @@ class TrainingLoop:
             # includes the other streams' queued programs and would
             # over-shrink the tuned size N-fold. Chunk 1 compiles;
             # chunk 2 times clean seconds/move. Both harvests feed the
-            # buffer — nothing is thrown away.
-            self._fold_result(
-                self.c.self_play.play_moves(cfg.ROLLOUT_CHUNK_MOVES)
-            )
+            # buffer — nothing is thrown away. The timed window covers
+            # the PLAY only (the fold/ingest is deferred past `dt`): the
+            # tuned size targets device seconds per move, and folding a
+            # chunk is host/ingest work that would inflate it.
+            self._process_rollout()
             t0 = time.perf_counter()
-            result = self.c.self_play.play_moves(cfg.ROLLOUT_CHUNK_MOVES)
+            result, payload = self._play_rollout(
+                self.c.self_play, cfg.ROLLOUT_CHUNK_MOVES
+            )
             dt = time.perf_counter() - t0
-            self._fold_result(result)
+            self._fold_result(result, payload=payload)
             self._maybe_tune_chunk(
                 cfg.ROLLOUT_CHUNK_MOVES, dt, warmed=True
             )

@@ -70,6 +70,16 @@ def run_training(
     # Must precede any backend init (a site hook can override the env
     # var and point a CPU-intended run at a possibly-wedged TPU).
     enforce_platform(train_config.DEVICE)
+    if train_config.DEVICE_REPLAY == "on":
+        # Forced device replay may land on the CPU backend (tests,
+        # smokes). XLA:CPU's async dispatch deadlocks under the
+        # device-replay thread topology, and the flag is latched at CPU
+        # client creation — so it must be set HERE, before any backend
+        # touch (see rl/device_buffer.py module docstring). No effect
+        # on accelerator backends.
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
     # Cluster membership must also precede backend init.
     multi_host = initialize_distributed(distributed_config)
     if multi_host and not is_primary():

@@ -74,6 +74,63 @@ def clamp_self_play_workers(requested: int) -> int:
     return requested
 
 
+def _make_buffer(
+    train_config: TrainConfig,
+    env_config: EnvConfig,
+    model_config: ModelConfig,
+    extractor,
+    mesh,
+) -> ExperienceBuffer:
+    """Pick the replay-ring home per `TrainConfig.DEVICE_REPLAY`.
+
+    The device ring (rl/device_buffer.py) requires a single-process,
+    single-device mesh — it lives on one chip. "auto" additionally
+    requires an accelerator backend: on the CPU backend host NumPy and
+    "device" memory are the same RAM, so the scatter program would add
+    overhead for nothing ("on" still forces it there — tests do).
+    """
+    import jax
+
+    mode = train_config.DEVICE_REPLAY
+    single = jax.process_count() == 1 and mesh.devices.size == 1
+    want = mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
+    if mode == "on" and not single:
+        # An explicit force that can't be honored must not silently
+        # substitute the other code path.
+        raise ValueError(
+            "DEVICE_REPLAY='on' needs a single-device, single-process "
+            f"mesh (got {mesh.devices.size} devices / "
+            f"{jax.process_count()} processes); use DEVICE_REPLAY='auto' "
+            "to fall back to the host buffer on multi-device meshes."
+        )
+    if want and not single:
+        logger.info(
+            "DEVICE_REPLAY=auto: multi-device mesh (%d devices / %d "
+            "processes) -> host buffer.",
+            mesh.devices.size,
+            jax.process_count(),
+        )
+    if want and single:
+        from ..rl.device_buffer import DeviceReplayBuffer
+
+        logger.info(
+            "Device-resident replay ring: capacity %d on %s.",
+            train_config.BUFFER_CAPACITY,
+            jax.devices()[0],
+        )
+        return DeviceReplayBuffer(
+            train_config,
+            grid_shape=(
+                model_config.GRID_INPUT_CHANNELS,
+                env_config.ROWS,
+                env_config.COLS,
+            ),
+            other_dim=extractor.other_dim,
+            action_dim=env_config.action_dim,
+        )
+    return ExperienceBuffer(train_config, action_dim=env_config.action_dim)
+
+
 def setup_training_components(
     train_config: TrainConfig | None = None,
     env_config: EnvConfig | None = None,
@@ -139,7 +196,7 @@ def setup_training_components(
     trainer = Trainer(
         net, train_config, mesh=mesh, mdl_axis=mesh_config.MDL_AXIS
     )
-    buffer = ExperienceBuffer(train_config, action_dim=env_config.action_dim)
+    buffer = _make_buffer(train_config, env_config, model_config, extractor, mesh)
     self_play = SelfPlayEngine(
         env,
         extractor,

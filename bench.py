@@ -358,6 +358,56 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         f"(batch {b}, K={fused_k})"
     )
 
+    # Device-resident replay (rl/device_buffer.py): batches are gathered
+    # on device from sampled indices, so a fused group uploads ~K*B*4
+    # bytes of indices instead of K full batches — the difference
+    # between link-bound and compute-bound on a tunneled/PCIe-fed chip.
+    # Measured on every backend except CPU (where host and "device"
+    # memory are the same RAM and the comparison is meaningless).
+    device_replay = backend != "cpu" and not smoke
+    dev_buffer = None
+    dev_steps_per_sec = None
+    if device_replay:
+        from alphatriangle_tpu.rl.device_buffer import DeviceReplayBuffer
+
+        dev_buffer = DeviceReplayBuffer(
+            train_cfg,
+            grid_shape=(
+                model_cfg.GRID_INPUT_CHANNELS,
+                env_cfg.ROWS,
+                env_cfg.COLS,
+            ),
+            other_dim=extractor.other_dim,
+            action_dim=env_cfg.action_dim,
+        )
+        fill = batch["grid"].astype(np.int8).astype(np.float32)
+        for _ in range(max(1, (train_cfg.MIN_BUFFER_SIZE_TO_TRAIN // b) + 1)):
+            dev_buffer.add_dense(
+                fill,
+                batch["other_features"],
+                batch["policy_target"],
+                batch["value_target"],
+            )
+
+        def dev_samples(k: int) -> list:
+            return [
+                dev_buffer.sample(b, current_train_step=trainer.global_step)
+                for _ in range(k)
+            ]
+
+        trainer.train_steps_from(dev_buffer, dev_samples(fused_k))  # compile
+        t0 = time.time()
+        for _ in range(n_steps // fused_k + 1):
+            trainer.train_steps_from(dev_buffer, dev_samples(fused_k))
+        jax.block_until_ready(trainer.state.params)
+        dev_steps_per_sec = (
+            (n_steps // fused_k + 1) * fused_k / (time.time() - t0)
+        )
+        log(
+            f"bench: device-replay learner {dev_steps_per_sec:.2f} steps/s "
+            f"(batch {b}, K={fused_k}, index-only uploads)"
+        )
+
     # --- overlapped producer/consumer (combined rates) ------------------
     # The phases above run each side alone; this measures both at once
     # (the training loop's ASYNC_ROLLOUTS topology): producer thread(s)
@@ -382,7 +432,13 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     # runs K steps per time slice between rollout chunks.
     overlap_k = fused_k if (smoke or backend == "cpu") else 64
     overlap_batches = [batch] * overlap_k
-    if overlap_k != fused_k:
+    if device_replay:
+        # Warm the K-sized device-gather program OUTSIDE the timed
+        # window (the host-path program is never dispatched here).
+        if overlap_k != fused_k:
+            assert dev_buffer is not None
+            trainer.train_steps_from(dev_buffer, dev_samples(overlap_k))
+    elif overlap_k != fused_k:
         trainer.train_steps(overlap_batches)  # compile
     if async_chunk != chunk:
         log(
@@ -407,15 +463,36 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     for e in engines:
         e.harvest()  # reset counters
     stop = threading.Event()
-    produced = {"moves": 0, "errors": []}
+    produced = {"moves": 0, "episodes": 0, "errors": []}
     lock = threading.Lock()
+    payloads: "queue.Queue | None" = None
+    import queue
+
+    if device_replay:
+        # Mirror the real overlapped loop's device-replay topology:
+        # producers enqueue device-resident payloads (no bulk fetch),
+        # the learner thread ingests them into the on-device ring and
+        # trains from index-only samples.
+        payloads = queue.Queue(maxsize=4)
 
     def producer(e) -> None:
         try:
             while not stop.is_set():
-                e.play_chunk(async_chunk)
-                with lock:
-                    produced["moves"] += async_chunk
+                if payloads is not None:
+                    stats, payload = e.play_moves_device(async_chunk)
+                    with lock:
+                        produced["moves"] += async_chunk
+                        produced["episodes"] += stats.num_episodes
+                    while not stop.is_set():
+                        try:
+                            payloads.put(payload, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                else:
+                    e.play_chunk(async_chunk)
+                    with lock:
+                        produced["moves"] += async_chunk
         except Exception as exc:  # surface, don't hang the bench
             with lock:
                 produced["errors"].append(f"{type(exc).__name__}: {exc}")
@@ -428,9 +505,23 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         th.start()
     t0 = time.time()
     o_steps = 0
+    o_ingested = 0
     pending = None
     while time.time() - t0 < overlap_seconds:
-        nxt = trainer.train_steps_begin(overlap_batches)
+        if payloads is not None:
+            assert dev_buffer is not None
+            while True:
+                try:
+                    o_ingested += dev_buffer.ingest_payload(
+                        payloads.get_nowait()
+                    )
+                except queue.Empty:
+                    break
+            nxt = trainer.train_steps_from_begin(
+                dev_buffer, dev_samples(overlap_k)
+            )
+        else:
+            nxt = trainer.train_steps_begin(overlap_batches)
         if pending is not None:
             o_steps += len(trainer.train_steps_finish(pending))
         pending = nxt
@@ -441,7 +532,10 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     for th in threads:
         th.join(timeout=120)
     o_elapsed = time.time() - t0
-    o_episodes = sum(e.harvest().num_episodes for e in engines)
+    if payloads is not None:
+        o_episodes = produced["episodes"]
+    else:
+        o_episodes = sum(e.harvest().num_episodes for e in engines)
     o_games_per_hour = o_episodes / o_elapsed * 3600.0
     overlapped = {
         "seconds": round(o_elapsed, 1),
@@ -459,6 +553,11 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         ),
         "learner_steps_per_sec": round(o_steps / o_elapsed, 2),
     }
+    if device_replay:
+        overlapped["device_replay"] = True
+        overlapped["experiences_ingested_per_sec"] = round(
+            o_ingested / o_elapsed, 1
+        )
     if produced["errors"]:
         overlapped["producer_errors"] = produced["errors"]
     log(f"bench: overlapped {overlapped}")

@@ -7,20 +7,29 @@ cd "$(dirname "$0")/.."
 out=benchmarks/tpu_r4_results.jsonl
 run() {
   label="$1"; shift
+  # Resumable: a section already recorded (an earlier run before a
+  # mid-sweep wedge) is skipped, so the watcher can relaunch the whole
+  # script until every section lands.
+  if grep -q "\"label\": \"$label\"" "$out" 2>/dev/null; then
+    echo "=== $label === already recorded; skipping" >&2
+    return 0
+  fi
   echo "=== $label ===" >&2
-  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 timeout 900 python bench.py)
+  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 timeout 1200 python bench.py)
   if [ -z "$line" ]; then
     echo "$label: bench produced no JSON (killed?); aborting sweep" >&2
     exit 1
   fi
-  echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
   # A section that fell back to CPU means the chip wedged mid-sweep:
   # every further section would burn its probe budget and record
-  # CPU-scale numbers under a TPU label. Stop; rerun in a new window.
+  # CPU-scale numbers under a TPU label. Abort WITHOUT recording the
+  # line — the resume-skip would otherwise pin the mislabeled row
+  # forever — and rerun in a new window.
   if ! printf '%s' "$line" | grep -q '"backend": "tpu"'; then
     echo "$label: backend != tpu (chip wedged?); aborting sweep" >&2
     exit 1
   fi
+  echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
 }
 # 1. Flagship, new default recipe (gumbel+PCR) + pipelined overlap + MFU.
 run flagship_gumbel_pcr BENCH_SECONDS=75

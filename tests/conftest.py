@@ -32,6 +32,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# XLA:CPU's async dispatch can deadlock when one thread blocks on an
+# in-flight program while another enqueues programs sharing its buffers
+# (the device-replay producer/consumer topology reproduces it at
+# flagship program sizes; see rl/device_buffer.py). Latched at CPU
+# client creation, so it must be set here, before any backend touch.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
