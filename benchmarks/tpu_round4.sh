@@ -15,7 +15,12 @@ run() {
     return 0
   fi
   echo "=== $label ===" >&2
-  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 timeout 1200 python bench.py)
+  # BENCH_NO_CPU_FALLBACK: a wedge mid-attempt aborts fast with an
+  # error line instead of burning minutes on a CPU run this sweep
+  # would refuse to record anyway. Outer timeout is a backstop above
+  # the supervisor's own probe (300s) + attempt (900s) budgets.
+  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 \
+    BENCH_NO_CPU_FALLBACK=1 timeout 1500 python bench.py)
   if [ -z "$line" ]; then
     echo "$label: bench produced no JSON (killed?); aborting sweep" >&2
     exit 1
