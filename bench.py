@@ -201,6 +201,17 @@ def run_bench(smoke: bool, seconds: float) -> dict:
                 cap, bundle["train"].SELF_PLAY_BATCH_SIZE
             )
             train_updates["ROLLOUT_CHUNK_MOVES"] = 4
+        if os.environ.get("BENCH_BATCH"):
+            # Lane-count A/B (see the non-preset path note). Still
+            # bounded by the cpu/smoke clamp above: a flagship lane
+            # count on a CPU fallback would blow the whole budget on
+            # one chunk.
+            requested = int(os.environ["BENCH_BATCH"])
+            if backend == "cpu" or smoke:
+                requested = min(
+                    requested, train_updates["SELF_PLAY_BATCH_SIZE"]
+                )
+            train_updates["SELF_PLAY_BATCH_SIZE"] = requested
         if backend == "cpu":
             model_cfg = model_cfg.model_copy(
                 update={"COMPUTE_DTYPE": "float32"}
@@ -252,6 +263,17 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             # Wave-size A/B: simulations evaluated in parallel per tree
             # (the MXU batch per eval is SELF_PLAY_BATCH_SIZE x wave).
             mcts_kw["mcts_batch_size"] = int(os.environ["BENCH_WAVE"])
+        if os.environ.get("BENCH_BATCH"):
+            # Lane-count A/B: more lockstep games per dispatch = bigger
+            # MXU batches per wave eval (flagship B=512 measured 1.4%
+            # self-play MFU — lane count is the direct lever on it).
+            # On cpu/smoke the scale's own lane count is the ceiling: a
+            # flagship lane count on a CPU fallback would blow the whole
+            # budget on one chunk.
+            requested = int(os.environ["BENCH_BATCH"])
+            if scale in ("cpu", "smoke"):
+                requested = min(requested, sp_batch)
+            sp_batch = requested
         recipe = os.environ.get(
             "BENCH_RECIPE", "gumbel_pcr" if scale == "flagship" else "puct"
         )
@@ -687,51 +709,79 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
     watchdog threads) cannot recover — only a child process the parent
     can kill. stderr is inherited so progress streams live.
     """
+    import select
+
     env = dict(os.environ, BENCH_CHILD="1")
     if platform:
         env["JAX_PLATFORMS"] = platform
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE,
-        text=True,
         env=env,
     )
-    timed_out = False
+    # Incremental select/os.read drain instead of communicate(): a child
+    # that emitted its JSON line and then wedged in an uninterruptible
+    # XLA teardown call never reaches EOF (its fds stay open), so
+    # communicate() would time out and discard the already-buffered
+    # result. Reading the pipe directly keeps whatever the child
+    # managed to flush, whatever its fate.
+    fd = proc.stdout.fileno()
+    buf = bytearray()
+
+    def drain(deadline: float) -> None:
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return
+            ready, _, _ = select.select(
+                [proc.stdout], [], [], min(remaining, 5.0)
+            )
+            if not ready:
+                if proc.poll() is not None:
+                    return  # child gone and pipe idle
+                continue
+            data = os.read(fd, 65536)
+            if not data:
+                return  # EOF
+            buf.extend(data)
+
+    drain(time.time() + timeout_s)
     try:
-        stdout, _ = proc.communicate(timeout=timeout_s)
+        # Grace for the EOF->exit race: a child that just closed stdout
+        # normally exits within moments.
+        proc.wait(timeout=5)
     except subprocess.TimeoutExpired:
+        pass
+    timed_out = proc.poll() is None
+    if timed_out:
         log(f"bench: attempt exceeded {timeout_s:.0f}s budget; killing")
-        timed_out = True
         proc.kill()
+        drain(time.time() + 5.0)  # salvage anything still in the pipe
         try:
-            # Drain the pipe after the kill: the child may have finished
-            # the measurement and emitted its JSON line, then wedged in
-            # XLA teardown — that result is real and worth keeping.
-            stdout, _ = proc.communicate(timeout=60)
+            proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             # A child blocked in an uninterruptible (D-state) XLA call
             # survives even SIGKILL until the kernel releases it; don't
             # let the zombie stop the supervisor from emitting its line.
             log("bench: child unkillable (D-state?); abandoning it")
-            return None
-    # Parse stdout regardless of exit status: a child that emitted its
-    # JSON line and THEN died (teardown segfault, budget kill mid-exit)
-    # still produced a real measurement.
-    for line in reversed((stdout or "").splitlines()):
+    # Parse regardless of exit status: a child that emitted its JSON
+    # line and THEN died or hung still produced a real measurement.
+    rc = proc.returncode
+    for line in reversed(buf.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 continue  # stray '{'-line after the real one; keep looking
-            if not timed_out and proc.returncode != 0:
+            if timed_out or (rc is not None and rc != 0):
                 log(
-                    f"bench: attempt exited rc={proc.returncode} after "
+                    f"bench: attempt ended abnormally (rc={rc}) after "
                     "emitting its result; keeping the measurement"
                 )
             return parsed
     if not timed_out:
-        log(f"bench: attempt exited rc={proc.returncode} with no JSON")
+        log(f"bench: attempt exited rc={rc} with no JSON")
     return None
 
 

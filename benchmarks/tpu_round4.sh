@@ -50,7 +50,12 @@ run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
 run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
 # 5. Multi-stream overlap.
 run flagship_workers2 BENCH_WORKERS=2 BENCH_SECONDS=60
-# 6. Wave-size A/B (MXU batch per eval = lanes x wave). PUCT recipe:
+# 6. Lane-count A/B: lanes are the direct lever on self-play MFU
+# (B=512 measured 1.4%); B=1024/2048 double/quadruple every wave's
+# MXU batch at the same program shape.
+run flagship_b1024 BENCH_BATCH=1024 BENCH_SECONDS=60
+run flagship_b2048 BENCH_BATCH=2048 BENCH_SECONDS=60
+# 7. Wave-size A/B (MXU batch per eval = lanes x wave). PUCT recipe:
 # under gumbel_pcr the fast searches clamp the wave anyway and a
 # 64-wave 64-sim gumbel collapses sequential halving to one phase —
 # the A/B would change the algorithm, not just the batching.
