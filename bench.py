@@ -112,6 +112,12 @@ def resolve_backend() -> "tuple[str, str | None]":
             f"timeout {this_timeout:.0f}s, budget {remaining:.0f}s left)..."
         )
         backend = probe_accelerator(this_timeout)
+        if backend == "cpu":
+            # CPU-only host (no accelerator plugin): there is no
+            # accelerator attempt to budget — go straight to the CPU
+            # path, with a note so sweep mode can abort fast.
+            log(f"bench: probe found cpu-only backend ({time.time() - t0:.1f}s)")
+            return "cpu", "probe found cpu-only backend (no accelerator)"
         if backend is not None:
             log(f"bench: probe OK ({backend}, {time.time() - t0:.1f}s total)")
             return "default", None
@@ -710,6 +716,7 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
     can kill. stderr is inherited so progress streams live.
     """
     import select
+    import signal
 
     env = dict(os.environ, BENCH_CHILD="1")
     if platform:
@@ -719,6 +726,20 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
         stdout=subprocess.PIPE,
         env=env,
     )
+
+    # The sweep wraps the supervisor in `timeout`, and the watcher can
+    # kill the sweep: either signal reaches only THIS process, and an
+    # orphaned JAX child would keep holding (or wedging) the chip for
+    # every later attempt. Forward the death to the child.
+    def _forward(signum, frame):
+        try:
+            proc.kill()
+        finally:
+            raise SystemExit(128 + signum)
+
+    old_term = signal.signal(signal.SIGTERM, _forward)
+    old_int = signal.signal(signal.SIGINT, _forward)
+
     # Incremental select/os.read drain instead of communicate(): a child
     # that emitted its JSON line and then wedged in an uninterruptible
     # XLA teardown call never reaches EOF (its fds stay open), so
@@ -728,60 +749,85 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
     fd = proc.stdout.fileno()
     buf = bytearray()
 
-    def drain(deadline: float) -> None:
+    def drain(deadline: float, stop_on_result: bool) -> str:
+        """Read until deadline/EOF — or, when stop_on_result, until the
+        buffer already holds the complete result line (stdout's contract
+        is ONE JSON line emitted as the child's last act; waiting out
+        the rest of the budget on an emit-then-wedge child wastes it)."""
         while True:
             remaining = deadline - time.time()
             if remaining <= 0:
-                return
+                return "deadline"
             ready, _, _ = select.select(
                 [proc.stdout], [], [], min(remaining, 5.0)
             )
             if not ready:
                 if proc.poll() is not None:
-                    return  # child gone and pipe idle
+                    return "exit"  # child gone and pipe idle
                 continue
             data = os.read(fd, 65536)
             if not data:
-                return  # EOF
+                return "eof"
             buf.extend(data)
+            if (
+                stop_on_result
+                and buf.endswith(b"\n")
+                and parse_last_json_line(buf) is not None
+            ):
+                return "result"
 
-    drain(time.time() + timeout_s)
     try:
-        # Grace for the EOF->exit race: a child that just closed stdout
-        # normally exits within moments.
-        proc.wait(timeout=5)
-    except subprocess.TimeoutExpired:
-        pass
-    timed_out = proc.poll() is None
-    if timed_out:
-        log(f"bench: attempt exceeded {timeout_s:.0f}s budget; killing")
-        proc.kill()
-        drain(time.time() + 5.0)  # salvage anything still in the pipe
+        reason = drain(time.time() + timeout_s, stop_on_result=True)
         try:
-            proc.wait(timeout=60)
+            # Grace for the finish->exit race: a child that just emitted
+            # its line / closed stdout normally exits within moments.
+            proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
-            # A child blocked in an uninterruptible (D-state) XLA call
-            # survives even SIGKILL until the kernel releases it; don't
-            # let the zombie stop the supervisor from emitting its line.
-            log("bench: child unkillable (D-state?); abandoning it")
+            pass
+        hung = proc.poll() is None
+        if hung:
+            if reason == "deadline":
+                log(f"bench: attempt exceeded {timeout_s:.0f}s budget; killing")
+            else:
+                log(f"bench: child stalled after {reason}; killing")
+            proc.kill()
+            drain(time.time() + 5.0, stop_on_result=False)  # salvage the pipe
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                # A child blocked in an uninterruptible (D-state) XLA
+                # call survives even SIGKILL until the kernel releases
+                # it; don't let the zombie stop the supervisor from
+                # emitting its line.
+                log("bench: child unkillable (D-state?); abandoning it")
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
     # Parse regardless of exit status: a child that emitted its JSON
     # line and THEN died or hung still produced a real measurement.
     rc = proc.returncode
+    parsed = parse_last_json_line(buf)
+    if parsed is not None:
+        if rc is None or rc != 0:
+            log(
+                f"bench: attempt ended abnormally (rc={rc}) after "
+                "emitting its result; keeping the measurement"
+            )
+        return parsed
+    if reason != "deadline":
+        log(f"bench: attempt ended ({reason}, rc={rc}) with no JSON")
+    return None
+
+
+def parse_last_json_line(buf: bytes) -> "dict | None":
+    """Last parseable '{'-line in a (possibly truncated) stdout capture."""
     for line in reversed(buf.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                parsed = json.loads(line)
+                return json.loads(line)
             except json.JSONDecodeError:
                 continue  # stray '{'-line after the real one; keep looking
-            if timed_out or (rc is not None and rc != 0):
-                log(
-                    f"bench: attempt ended abnormally (rc={rc}) after "
-                    "emitting its result; keeping the measurement"
-                )
-            return parsed
-    if not timed_out:
-        log(f"bench: attempt exited rc={rc} with no JSON")
     return None
 
 

@@ -1,0 +1,85 @@
+"""The bench supervisor's one-JSON-line contract (bench.py).
+
+The driver records `python bench.py` stdout as the round's benchmark
+artifact, so the supervisor must emit exactly one parseable line under
+every failure mode — wedged probe, post-probe hang, child crash — and
+must never silently relabel a failed accelerator attempt as a
+measurement. These tests pin the failure-path plumbing that can't be
+exercised on a healthy machine (pure-Python paths; no JAX import in
+the supervisor process).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BENCH = REPO / "bench.py"
+
+sys.path.insert(0, str(REPO))
+import bench  # noqa: E402
+
+
+class TestParseLastJsonLine:
+    def test_picks_last_valid_line(self):
+        buf = b'{"a": 1}\nnoise\n{"b": 2}\n'
+        assert bench.parse_last_json_line(buf) == {"b": 2}
+
+    def test_skips_trailing_garbage_brace_line(self):
+        # An atexit hook printing a '{'-prefixed non-JSON line must not
+        # mask the real result emitted just before it.
+        buf = b'{"metric": "x", "value": 1}\n{not json\n'
+        assert bench.parse_last_json_line(buf) == {"metric": "x", "value": 1}
+
+    def test_truncated_tail_falls_back_to_previous(self):
+        # A budget kill can cut the pipe mid-line.
+        buf = b'{"metric": "x"}\n{"metric": "y", "val'
+        assert bench.parse_last_json_line(buf) == {"metric": "x"}
+
+    def test_no_json_returns_none(self):
+        assert bench.parse_last_json_line(b"just logs\n") is None
+        assert bench.parse_last_json_line(b"") is None
+
+
+class TestErrorResult:
+    def test_shape_matches_contract(self):
+        out = bench.error_result({"backend": "none"})
+        assert out["metric"] == "self_play_games_per_hour"
+        assert out["value"] == 0.0
+        assert out["unit"] == "games/hour"
+        assert out["vs_baseline"] == 0.0
+        assert out["extra"] == {"backend": "none"}
+
+
+@pytest.mark.slow
+class TestSupervisorErrorPath:
+    def test_no_fallback_emits_error_line_fast(self):
+        """Probe budget too small to attempt -> immediate error line
+        (sweep mode), no JAX ever imported, well under a minute."""
+        env = dict(
+            os.environ,
+            BENCH_INIT_BUDGET="5",  # < 30s floor: zero probe attempts
+            BENCH_NO_CPU_FALLBACK="1",
+        )
+        env.pop("JAX_PLATFORMS", None)  # must not look explicit-cpu
+        r = subprocess.run(
+            [sys.executable, str(BENCH)],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env=env,
+            cwd=REPO,
+        )
+        assert r.returncode == 0
+        lines = [
+            ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")
+        ]
+        assert len(lines) == 1, r.stdout
+        out = json.loads(lines[0])
+        assert out["value"] == 0.0
+        assert out["extra"]["backend"] == "none"
+        assert "probe" in out["extra"]["error"]
