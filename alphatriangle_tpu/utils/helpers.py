@@ -28,6 +28,40 @@ def enforce_platform(device: str = "auto") -> None:
     if want_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
+    # Every runtime entry point passes through here before backend
+    # init, so it doubles as the hook for the cross-process executable
+    # cache (the helper honors the ALPHATRIANGLE_NO_COMPILE_CACHE=1
+    # opt-out).
+    enable_persistent_compilation_cache()
+
+
+def enable_persistent_compilation_cache(
+    cache_dir: str | None = None,
+) -> None:
+    """Cache compiled XLA executables on disk across processes.
+
+    The flagship self-play program costs ~70s to compile on the
+    tunneled TPU; every CLI invocation, bench section, and training-run
+    restart used to pay it again. The persistent cache keys serialized
+    executables by HLO + backend, so repeat invocations skip straight
+    to dispatch. Honors `JAX_COMPILATION_CACHE_DIR` if set; safe to
+    call before or after backend init (config-level setting).
+    """
+    if os.environ.get("ALPHATRIANGLE_NO_COMPILE_CACHE") == "1":
+        return  # operator opt-out (e.g. suspected stale/corrupt cache)
+    path = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or "/tmp/alphatriangle_tpu_jax_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache everything but trivial programs (default threshold 1s
+        # would skip the many small host-side utility jits — fine — but
+        # be explicit so the big programs always land).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # unknown flag on an old jax: not fatal
+        logger.warning("persistent compilation cache unavailable: %s", exc)
 
 
 def get_device(preference: str = "auto") -> jax.Device:

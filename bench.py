@@ -57,32 +57,70 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+# Children the supervisor currently has in flight, so a SIGTERM/SIGINT
+# to the supervisor (the sweep's `timeout`, the watcher killing the
+# sweep) can be forwarded instead of orphaning a JAX process that keeps
+# holding — or wedging — the chip for every later attempt.
+_live_children: "list[subprocess.Popen]" = []
+
+
+def install_signal_forwarding() -> None:
+    import signal
+
+    def _forward(signum, frame):
+        for child in list(_live_children):
+            try:
+                child.kill()
+            except Exception:
+                pass
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+
 def probe_accelerator(timeout_s: float) -> "str | None":
     """Initialize JAX in a child process; return its backend name or None.
 
     The child inherits the ambient environment (including any accelerator
     plugin sitecustomize), so it exercises exactly the init path this
     process would take. Timeout or nonzero exit -> None (accelerator sick).
+    A CPU answer that comes with a backend-init failure warning is ALSO
+    None: that is a present-but-sick accelerator plugin falling back, not
+    a cpu-only host, and it deserves the retry budget.
     """
     code = "import jax; print('BACKEND=' + jax.default_backend())"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    _live_children.append(proc)
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         log(f"bench: accelerator probe timed out after {timeout_s:.0f}s")
+        proc.kill()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
         return None
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()[-3:]
-        log(f"bench: accelerator probe failed rc={r.returncode}: {tail}")
+    finally:
+        _live_children.remove(proc)
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()[-3:]
+        log(f"bench: accelerator probe failed rc={proc.returncode}: {tail}")
         return None
-    for line in r.stdout.splitlines():
+    backend = None
+    for line in (stdout or "").splitlines():
         if line.startswith("BACKEND="):
-            return line.split("=", 1)[1].strip()
-    return None
+            backend = line.split("=", 1)[1].strip()
+    if backend == "cpu" and "Unable to initialize backend" in (stderr or ""):
+        log("bench: probe fell back to CPU (plugin init failed) — retryable")
+        return None
+    return backend
 
 
 def resolve_backend() -> "tuple[str, str | None]":
@@ -143,6 +181,13 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     from alphatriangle_tpu.features.core import get_feature_extractor
     from alphatriangle_tpu.nn.network import NeuralNetwork
     from alphatriangle_tpu.rl import SelfPlayEngine, Trainer
+    from alphatriangle_tpu.utils.helpers import (
+        enable_persistent_compilation_cache,
+    )
+
+    # The flagship programs cost ~70s each to compile on the tunneled
+    # chip; sweep sections repeat them. Cache executables across runs.
+    enable_persistent_compilation_cache()
 
     backend = jax.default_backend()
     device = jax.devices()[0]
@@ -716,7 +761,6 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
     can kill. stderr is inherited so progress streams live.
     """
     import select
-    import signal
 
     env = dict(os.environ, BENCH_CHILD="1")
     if platform:
@@ -726,19 +770,7 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
         stdout=subprocess.PIPE,
         env=env,
     )
-
-    # The sweep wraps the supervisor in `timeout`, and the watcher can
-    # kill the sweep: either signal reaches only THIS process, and an
-    # orphaned JAX child would keep holding (or wedging) the chip for
-    # every later attempt. Forward the death to the child.
-    def _forward(signum, frame):
-        try:
-            proc.kill()
-        finally:
-            raise SystemExit(128 + signum)
-
-    old_term = signal.signal(signal.SIGTERM, _forward)
-    old_int = signal.signal(signal.SIGINT, _forward)
+    _live_children.append(proc)
 
     # Incremental select/os.read drain instead of communicate(): a child
     # that emitted its JSON line and then wedged in an uninterruptible
@@ -778,10 +810,13 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
 
     try:
         reason = drain(time.time() + timeout_s, stop_on_result=True)
+        grace = 30.0 if reason in ("result", "eof") else 5.0
         try:
-            # Grace for the finish->exit race: a child that just emitted
-            # its line / closed stdout normally exits within moments.
-            proc.wait(timeout=5)
+            # Grace for the finish->exit race. After a clean result/EOF
+            # the child is presumably in JAX/TPU runtime teardown — give
+            # it long enough to shut the chip down cleanly rather than
+            # SIGKILLing a correctly-exiting process every run.
+            proc.wait(timeout=grace)
         except subprocess.TimeoutExpired:
             pass
         hung = proc.poll() is None
@@ -790,19 +825,23 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
                 log(f"bench: attempt exceeded {timeout_s:.0f}s budget; killing")
             else:
                 log(f"bench: child stalled after {reason}; killing")
-            proc.kill()
-            drain(time.time() + 5.0, stop_on_result=False)  # salvage the pipe
+            # SIGTERM first (lets atexit/PJRT teardown run), then KILL.
+            proc.terminate()
+            drain(time.time() + 10.0, stop_on_result=False)  # salvage pipe
             try:
-                proc.wait(timeout=60)
+                proc.wait(timeout=20)
             except subprocess.TimeoutExpired:
-                # A child blocked in an uninterruptible (D-state) XLA
-                # call survives even SIGKILL until the kernel releases
-                # it; don't let the zombie stop the supervisor from
-                # emitting its line.
-                log("bench: child unkillable (D-state?); abandoning it")
+                proc.kill()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    # A child blocked in an uninterruptible (D-state)
+                    # XLA call survives even SIGKILL until the kernel
+                    # releases it; don't let the zombie stop the
+                    # supervisor from emitting its line.
+                    log("bench: child unkillable (D-state?); abandoning it")
     finally:
-        signal.signal(signal.SIGTERM, old_term)
-        signal.signal(signal.SIGINT, old_int)
+        _live_children.remove(proc)
     # Parse regardless of exit status: a child that emitted its JSON
     # line and THEN died or hung still produced a real measurement.
     rc = proc.returncode
@@ -837,7 +876,9 @@ def main() -> None:
         return
 
     # Supervisor: never touches JAX itself, so it can always emit the
-    # JSON line no matter what the accelerator does.
+    # JSON line no matter what the accelerator does. Signals are
+    # forwarded to whichever probe/measurement child is in flight.
+    install_signal_forwarding()
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     seconds = float(os.environ.get("BENCH_SECONDS", "8" if smoke else "75"))
     decision, probe_error = resolve_backend()
