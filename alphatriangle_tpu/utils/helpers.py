@@ -56,10 +56,6 @@ def enable_persistent_compilation_cache(
     )
     try:
         jax.config.update("jax_compilation_cache_dir", path)
-        # Cache everything but trivial programs (default threshold 1s
-        # would skip the many small host-side utility jits — fine — but
-        # be explicit so the big programs always land).
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as exc:  # unknown flag on an old jax: not fatal
         logger.warning("persistent compilation cache unavailable: %s", exc)
 

@@ -79,6 +79,25 @@ def install_signal_forwarding() -> None:
     signal.signal(signal.SIGINT, _forward)
 
 
+def spawn_registered(args: list, **popen_kw) -> subprocess.Popen:
+    """Popen + _live_children registration, atomic w.r.t. signals.
+
+    A SIGTERM landing between Popen() returning and the append would
+    orphan the just-spawned JAX child — exactly the chip-holding orphan
+    the forwarding exists to prevent. Block TERM/INT across the pair.
+    """
+    import signal
+
+    mask = {signal.SIGTERM, signal.SIGINT}
+    old = signal.pthread_sigmask(signal.SIG_BLOCK, mask)
+    try:
+        proc = subprocess.Popen(args, **popen_kw)
+        _live_children.append(proc)
+    finally:
+        signal.pthread_sigmask(signal.SIG_SETMASK, old)
+    return proc
+
+
 def probe_accelerator(timeout_s: float) -> "str | None":
     """Initialize JAX in a child process; return its backend name or None.
 
@@ -90,13 +109,12 @@ def probe_accelerator(timeout_s: float) -> "str | None":
     a cpu-only host, and it deserves the retry budget.
     """
     code = "import jax; print('BACKEND=' + jax.default_backend())"
-    proc = subprocess.Popen(
+    proc = spawn_registered(
         [sys.executable, "-c", code],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
     )
-    _live_children.append(proc)
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -704,6 +722,13 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
             "learner_steps_per_sec": round(learner_steps_per_sec, 2),
             "learner_steps_per_sec_fused": round(fused_steps_per_sec, 2),
+            # Device-resident replay ring (index-only uploads); None on
+            # cpu/smoke runs where the ring is not exercised.
+            "learner_steps_per_sec_device_replay": (
+                round(dev_steps_per_sec, 2)
+                if dev_steps_per_sec is not None
+                else None
+            ),
             "fused_group_size": fused_k,
             "learner_batch": b,
             "first_chunk_compile_seconds": round(compile_s, 1),
@@ -730,6 +755,14 @@ def child_main() -> None:
     and emit the one JSON line. Invoked by the supervisor (BENCH_CHILD=1);
     a crash still emits, but a WEDGE here simply hangs — the supervisor's
     wall-clock budget is the recovery path."""
+    import signal
+
+    # Python's default SIGTERM disposition kills the process without
+    # running atexit — the supervisor's graceful-kill rung (terminate
+    # before kill) only buys a clean PJRT/chip teardown if we convert
+    # the signal into a normal interpreter exit.
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(143))
+
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     seconds = float(os.environ.get("BENCH_SECONDS", "8" if smoke else "75"))
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
@@ -765,12 +798,11 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
     env = dict(os.environ, BENCH_CHILD="1")
     if platform:
         env["JAX_PLATFORMS"] = platform
-    proc = subprocess.Popen(
+    proc = spawn_registered(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE,
         env=env,
     )
-    _live_children.append(proc)
 
     # Incremental select/os.read drain instead of communicate(): a child
     # that emitted its JSON line and then wedged in an uninterruptible

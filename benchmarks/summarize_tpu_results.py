@@ -33,10 +33,10 @@ def main() -> int:
 
     print(
         "| label | backend | games/h | leaf-evals/s | learner steps/s "
-        "(fused) | self-play MFU | overlapped g/h (vs serial) | "
-        "overlapped steps/s |"
+        "(fused) | device-replay steps/s | self-play MFU | "
+        "overlapped g/h (vs serial) | overlapped steps/s |"
     )
-    print("|---|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|---|")
     gather = {}
     for row in rows:
         r = row["result"]
@@ -48,6 +48,7 @@ def main() -> int:
             f"| {row['label']} | {e.get('backend')} | {r.get('value'):,} | "
             f"{e.get('mcts_leaf_evals_per_sec')} | "
             f"{e.get('learner_steps_per_sec_fused')} | "
+            f"{e.get('learner_steps_per_sec_device_replay')} | "
             f"{mfu if mfu is None else f'{100 * mfu:.1f}%'} | "
             f"{o.get('games_per_hour')} ({o.get('vs_serialized_self_play')}) | "
             f"{o.get('learner_steps_per_sec')} |"
