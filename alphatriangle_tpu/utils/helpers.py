@@ -28,10 +28,9 @@ def enforce_platform(device: str = "auto") -> None:
     if want_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
-    # Every runtime entry point passes through here before backend
-    # init, so it doubles as the hook for the cross-process executable
-    # cache (the helper honors the ALPHATRIANGLE_NO_COMPILE_CACHE=1
-    # opt-out).
+    # Every runtime entry point passes through here, so it doubles as
+    # the hook for the cross-process executable cache; the helper
+    # itself skips CPU runs and honors the opt-out env.
     enable_persistent_compilation_cache()
 
 
@@ -45,10 +44,25 @@ def enable_persistent_compilation_cache(
     restart used to pay it again. The persistent cache keys serialized
     executables by HLO + backend, so repeat invocations skip straight
     to dispatch. Honors `JAX_COMPILATION_CACHE_DIR` if set; safe to
-    call before or after backend init (config-level setting).
+    call before or after backend init (the cache is consulted per
+    compile, not at client creation).
+
+    ACCELERATOR BACKENDS ONLY: XLA:CPU's cached AOT results record
+    compile-time tuning pseudo-features (`+prefer-no-scatter`, ...)
+    that fail the host feature check on reload, logging SIGILL-risk
+    errors — and CPU compiles are cheap anyway. The gate lives here:
+    a run whose platform is pinned to cpu (env or config — the
+    `enforce_platform` pattern, used by every CPU entry point and the
+    test conftest) is skipped, without touching backend init.
     """
     if os.environ.get("ALPHATRIANGLE_NO_COMPILE_CACHE") == "1":
         return  # operator opt-out (e.g. suspected stale/corrupt cache)
+    platforms = (
+        os.environ.get("JAX_PLATFORMS", "")
+        or str(getattr(jax.config, "jax_platforms", None) or "")
+    ).strip().lower()
+    if platforms == "cpu":
+        return
     path = (
         cache_dir
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")

@@ -38,6 +38,9 @@ Env knobs:
   BENCH_NO_CPU_FALLBACK=1  emit the error line instead of a CPU run when
                         the accelerator attempt fails (sweep mode; an
                         explicit JAX_PLATFORMS=cpu request still runs)
+  BENCH_PROFILE=1       capture an XLA trace of the first ~3 measured
+                        chunks (BENCH_PROFILE_DIR, default
+                        benchmarks/bench_profile); read with cli analyze
   JAX_PLATFORMS=cpu     skip the probe, run straight on CPU
   BENCH_CHILD=1         internal: marks the supervised measurement child
 """
@@ -203,11 +206,12 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         enable_persistent_compilation_cache,
     )
 
-    # The flagship programs cost ~70s each to compile on the tunneled
-    # chip; sweep sections repeat them. Cache executables across runs.
-    enable_persistent_compilation_cache()
-
     backend = jax.default_backend()
+    # The flagship programs cost ~70s each to compile on the tunneled
+    # chip; sweep sections repeat them. Cache executables across runs
+    # (the helper itself skips cpu-pinned runs — XLA:CPU AOT reloads
+    # carry a SIGILL risk).
+    enable_persistent_compilation_cache()
     device = jax.devices()[0]
     log(
         "bench: backend="
@@ -391,11 +395,38 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     log(f"bench: first chunk (compile) {compile_s:.1f}s; measuring {seconds:.0f}s...")
     engine.harvest()  # reset counters after warmup
 
+    # BENCH_PROFILE=1: capture a jax.profiler (XLA) trace of the first
+    # few measured chunks — the ground truth for where self-play MFU
+    # goes (tree ops vs network matmuls vs dispatch gaps). Kept out of
+    # the headline sections; `cli analyze <dir>` reads the result.
+    profile_dir = None
+    if os.environ.get("BENCH_PROFILE") == "1":
+        profile_dir = os.environ.get(
+            "BENCH_PROFILE_DIR", "benchmarks/bench_profile"
+        )
+        jax.profiler.start_trace(profile_dir)
+
+    def stop_profile() -> None:
+        nonlocal profile_dir
+        if profile_dir is not None:
+            jax.profiler.stop_trace()
+            log(f"bench: profiler trace written to {profile_dir}")
+            profile_dir = None
+
     t0 = time.time()
     moves = 0
-    while time.time() - t0 < seconds:
-        engine.play_chunk()
-        moves += chunk
+    try:
+        while time.time() - t0 < seconds:
+            engine.play_chunk()
+            moves += chunk
+            if moves >= 3 * chunk:
+                # ~3 chunks of trace is plenty; tracing is not free, so
+                # stop before it skews the rest of the window.
+                stop_profile()
+    finally:
+        # Flush the trace even if a chunk raises (chip wedge mid-run):
+        # the partial capture is exactly the diagnosis data we want.
+        stop_profile()
     elapsed = time.time() - t0
     result = engine.harvest()
     episodes = result.num_episodes

@@ -61,4 +61,7 @@ run flagship_b2048 BENCH_BATCH=2048 BENCH_SECONDS=60
 # the A/B would change the algorithm, not just the batching.
 run wave16 BENCH_WAVE=16 BENCH_RECIPE=puct BENCH_SECONDS=45
 run wave64 BENCH_WAVE=64 BENCH_RECIPE=puct BENCH_SECONDS=45
+# 8. XLA trace of the flagship self-play (not a headline number — the
+# MFU diagnosis input for the next optimization round).
+run flagship_profile BENCH_PROFILE=1 BENCH_SECONDS=30
 echo "sweep complete" >&2
