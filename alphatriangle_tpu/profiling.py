@@ -166,6 +166,7 @@ def analyze_profile_dir(profile_dir: str, top: int = 20) -> int:
         print(f"\n{len(traces)} device trace(s):")
         for t in traces[:top]:
             print(f"  {t}")
+            summarize_xplane_trace(t, top=top)
         print(
             "View with: tensorboard --logdir "
             f"{root} (PROFILE tab)"
@@ -173,3 +174,60 @@ def analyze_profile_dir(profile_dir: str, top: int = 20) -> int:
     elif not dump.exists():
         return 1
     return 0
+
+
+def summarize_xplane_trace(path: Path, top: int = 20) -> None:
+    """Top ops per plane of a jax.profiler xplane trace, in-terminal.
+
+    The image's tensorboard profile plugin can't load this TF build
+    (pywrap converter mismatch), so aggregate the raw XSpace protobuf
+    directly: per plane (device core / host), sum event durations by op
+    name. This is the table that says where self-play MFU actually goes
+    (network matmuls vs tree-op gathers vs dispatch gaps) — the bench's
+    BENCH_PROFILE section and the sweep's flagship_profile row feed it.
+    Gracefully degrades when the TF tsl protos aren't importable.
+    """
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as exc:
+        print(f"  (xplane summary unavailable: {exc})")
+        return
+    xs = xplane_pb2.XSpace()
+    try:
+        xs.ParseFromString(path.read_bytes())
+    except Exception as exc:
+        print(f"  (unreadable trace: {exc})")
+        return
+    for plane in xs.planes:
+        meta = {m.id: m.name for m in plane.event_metadata.values()}
+        # Aggregate PER LINE: a device plane carries hierarchical lines
+        # ("XLA Modules" spans everything its "XLA Ops" line itemizes),
+        # so summing across lines would double-count and crown the
+        # module name as the top "op".
+        for line in plane.lines:
+            if not line.events:
+                continue
+            total_ps: dict[str, int] = defaultdict(int)
+            count: dict[str, int] = defaultdict(int)
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, f"op#{ev.metadata_id}")
+                total_ps[name] += ev.duration_ps
+                count[name] += 1
+            grand_ps = sum(total_ps.values())
+            rows = sorted(
+                total_ps.items(), key=lambda kv: kv[1], reverse=True
+            )
+            line_name = line.name or f"line#{line.id}"
+            print(
+                f"\n  plane {plane.name} / {line_name}: "
+                f"{len(line.events)} events, {grand_ps / 1e12:.3f}s "
+                "summed op time"
+            )
+            print(f"    {'op':<52} {'total ms':>10} {'count':>8} {'%':>6}")
+            for name, ps in rows[:top]:
+                pct = 100.0 * ps / max(grand_ps, 1)
+                label = name if len(name) <= 52 else name[:49] + "..."
+                print(
+                    f"    {label:<52} {ps / 1e9:>10.2f} "
+                    f"{count[name]:>8d} {pct:>5.1f}%"
+                )

@@ -4,6 +4,8 @@
 import json
 import time
 
+import pytest
+
 from alphatriangle_tpu.profiling import PhaseTimers, ProfileSession
 
 
@@ -79,3 +81,33 @@ class TestProfileSession:
         s.close()  # must stop it and dump timers
         assert (tmp_path / "p" / "phase_timers.json").exists()
         assert list((tmp_path / "p").glob("**/*.xplane.pb"))
+
+
+class TestXplaneSummary:
+    def test_summarize_real_trace(self, tmp_path, capsys):
+        """The in-terminal top-ops table parses a real jax trace (the
+        tensorboard profile plugin can't load this TF build, so the
+        raw-XSpace path is the only analysis surface)."""
+        pytest.importorskip("tensorflow.tsl.profiler.protobuf")
+        import jax
+        import jax.numpy as jnp
+
+        from alphatriangle_tpu.profiling import summarize_xplane_trace
+
+        jax.profiler.start_trace(str(tmp_path / "t"))
+        jax.jit(lambda x: x @ x)(jnp.ones((64, 64))).block_until_ready()
+        jax.profiler.stop_trace()
+        traces = list((tmp_path / "t").glob("**/*.xplane.pb"))
+        assert traces
+        summarize_xplane_trace(traces[0], top=5)
+        out = capsys.readouterr().out
+        assert "plane" in out and "total ms" in out
+
+    def test_unreadable_trace_degrades(self, tmp_path, capsys):
+        from alphatriangle_tpu.profiling import summarize_xplane_trace
+
+        bad = tmp_path / "x.xplane.pb"
+        bad.write_bytes(b"\x01\x02not a proto")
+        summarize_xplane_trace(bad, top=5)
+        out = capsys.readouterr().out
+        assert "unreadable trace" in out or "unavailable" in out
