@@ -84,4 +84,29 @@ def largest_pow2_leq(n: int) -> int:
     return 1 << int(math.log2(n)) if n >= 1 else 1
 
 
+def rollout_lane_axes(
+    mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"
+) -> tuple:
+    """Mesh axes the self-play lockstep lanes shard over.
+
+    Lanes ride dp — plus sp when that axis is real: sequence
+    parallelism never applies to the board-sized rollout net, so a
+    configured sp axis would otherwise idle (or duplicate rollout
+    work) during self-play. The single source of this rule for
+    training/setup.py, the driver dryrun, and the engine's
+    divisibility check — they must exercise the SAME sharding.
+    """
+    if mesh.shape.get(sp_axis, 1) > 1:
+        return (dp_axis, sp_axis)
+    return (dp_axis,)
+
+
+def lane_shard_count(mesh: Mesh, axes: tuple) -> int:
+    """How many ways the lane dim splits over `axes` of `mesh`."""
+    n = 1
+    for ax in axes:
+        n *= mesh.shape.get(ax, 1)
+    return n
+
+
 MeshConfig.model_rebuild(force=True)

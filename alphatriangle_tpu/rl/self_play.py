@@ -87,12 +87,24 @@ class SelfPlayEngine:
         batch_size: int | None = None,
         seed: int = 0,
         share_compiled: "SelfPlayEngine | None" = None,
+        mesh: "jax.sharding.Mesh | None" = None,
+        data_axes: tuple = ("dp",),
     ):
         """`share_compiled`: another engine whose jitted chunk programs
         this one reuses (multi-stream rollouts, training/loop.py). The
         rollout computation depends only on configs — carry, weights
         and version are arguments — so identically-configured streams
         must not compile the heaviest program in the codebase N times.
+
+        `mesh`: shard the lockstep lanes over the mesh's `data_axes`
+        (B games -> B/n per device, ONE jitted program spanning the
+        mesh) so rollouts occupy every chip — the TPU counterpart of
+        the reference fanning self-play actors across hardware
+        (`alphatriangle/training/worker_manager.py:39-75`). Every lane
+        is independent, so GSPMD partitions the chunk program with no
+        cross-device collectives; network weights ride replicated (or
+        tensor-sharded, if the caller hands mesh-sharded variables —
+        the specs compose). None = single-device engine (unchanged).
         """
         self.env = env
         self.extractor = extractor
@@ -139,6 +151,38 @@ class SelfPlayEngine:
         self._other_dim = f
         self._action_dim = a
 
+        self.mesh = mesh
+        self.data_axes = tuple(data_axes)
+        self._lane_sharding = None
+        self._replicated = None
+        # (weights_version, mesh-replicated variables) memo for
+        # _place_variables — held on the PRIMARY engine so N rollout
+        # streams sharing one net share one replicated copy instead of
+        # uploading (and pinning in HBM) N of them.
+        self._placed_variables: tuple | None = None
+        self._placed_owner: "SelfPlayEngine" = (
+            # Follow the chain so every stream lands on one root owner.
+            share_compiled._placed_owner
+            if share_compiled is not None
+            else self
+        )
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..config.mesh_config import lane_shard_count
+
+            shards = lane_shard_count(mesh, self.data_axes)
+            if b % shards != 0:
+                raise ValueError(
+                    f"SELF_PLAY_BATCH_SIZE={b} must divide evenly over "
+                    f"the mesh data axes {self.data_axes} "
+                    f"({shards} shards)."
+                )
+            self._lane_sharding = NamedSharding(
+                mesh, PartitionSpec(self.data_axes)
+            )
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+
         rng = jax.random.PRNGKey(seed)
         rng, reset_key = jax.random.split(rng)
         version0 = self.net.weights_version
@@ -155,6 +199,10 @@ class SelfPlayEngine:
             episode_start_version=jnp.full((b,), version0, jnp.int32),
             move_index=jnp.int32(0),
         )
+        if self._lane_sharding is not None:
+            self._carry = jax.device_put(
+                self._carry, self._carry_shardings()
+            )
 
         # One compiled program per distinct chunk length, carry donated
         # so XLA reuses the window buffers in place.
@@ -163,10 +211,14 @@ class SelfPlayEngine:
                 share_compiled.batch_size != self.batch_size
                 or share_compiled.mcts_config != self.mcts_config
                 or share_compiled.config != self.config
+                or share_compiled.mesh is not self.mesh
+                or share_compiled.data_axes != self.data_axes
             ):
                 raise ValueError(
                     "share_compiled requires identically-configured "
-                    "engines (batch size / MCTS / train configs)."
+                    "engines (batch size / MCTS / train configs / "
+                    "mesh + data axes — jit specializes per input "
+                    "sharding, so a mismatch would recompile anyway)."
                 )
             self._chunk_fn = share_compiled._chunk_fn
         else:
@@ -190,6 +242,61 @@ class SelfPlayEngine:
         self._total_simulations = 0
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
+
+    # --- multi-chip lane sharding -----------------------------------------
+
+    def _carry_shardings(self) -> RolloutCarry:
+        """Sharding pytree matching the carry: every (B, ...) leaf
+        shards its lane dim over the mesh's data axes; the single PRNG
+        key and the scalar move counter replicate."""
+        lane, rep = self._lane_sharding, self._replicated
+        return RolloutCarry(
+            env=jax.tree_util.tree_map(lambda _: lane, self._carry.env),
+            rng=rep,
+            pend_grid=lane,
+            pend_other=lane,
+            pend_policy=lane,
+            pend_pweight=lane,
+            pend_return=lane,
+            pend_discount=lane,
+            pend_active=lane,
+            episode_start_version=lane,
+            move_index=rep,
+        )
+
+    def _place_variables(self, variables, version: int):
+        """Place net weights for a mesh-spanning chunk dispatch.
+
+        Weights already sharded on THIS mesh (e.g. the trainer's
+        tensor-parallel specs after a zero-copy sync) pass through —
+        their specs compose with the lane sharding, giving TP network
+        evals inside the search. Anything else (fresh init committed to
+        one device, checkpoint restore) is replicated across the mesh;
+        mixing single-device-committed and mesh-sharded args in one jit
+        is an error JAX refuses at dispatch time. The replicated copy
+        is cached per weights version — without it every chunk of a
+        pre-first-sync run would re-upload the full network.
+        """
+        if self.mesh is None:
+            return variables
+        from jax.sharding import NamedSharding
+
+        leaf = jax.tree_util.tree_leaves(variables)[0]
+        sh = getattr(leaf, "sharding", None)
+        owner = self._placed_owner
+        if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+            # Trainer-sharded weights took over: drop any pre-sync
+            # replicated copy so it doesn't pin a dead full-model
+            # buffer in HBM for the rest of the run.
+            owner._placed_variables = None
+            return variables
+        if owner._placed_variables is not None:
+            cached_version, placed = owner._placed_variables
+            if cached_version == version:
+                return placed
+        placed = jax.device_put(variables, self._replicated)
+        owner._placed_variables = (version, placed)
+        return placed
 
     # --- device-side chunk ------------------------------------------------
 
@@ -422,7 +529,9 @@ class SelfPlayEngine:
             else min(self._min_weights_version, version)
         )
         self._carry, outputs = self._chunk_fn(t)(
-            self.net.variables, self._carry, jnp.int32(version)
+            self._place_variables(self.net.variables, version),
+            self._carry,
+            jnp.int32(version),
         )
         payload: dict | None = None
         if fetch_experiences:

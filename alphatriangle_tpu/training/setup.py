@@ -12,7 +12,11 @@ import os
 
 from ..config.env_config import EnvConfig
 from ..config.mcts_config import MCTSConfig
-from ..config.mesh_config import MeshConfig
+from ..config.mesh_config import (
+    MeshConfig,
+    lane_shard_count,
+    rollout_lane_axes,
+)
 from ..config.model_config import ModelConfig
 from ..config.persistence_config import PersistenceConfig
 from ..config.train_config import TrainConfig
@@ -197,6 +201,37 @@ def setup_training_components(
         net, train_config, mesh=mesh, mdl_axis=mesh_config.MDL_AXIS
     )
     buffer = _make_buffer(train_config, env_config, model_config, extractor, mesh)
+    # Multi-device mesh: shard the lockstep lanes so rollouts occupy
+    # every chip, not just one of the learner's (the reference fans
+    # self-play actors across hardware, `worker_manager.py:39-75`).
+    # Lanes ride the dp axis plus sp when present — sequence
+    # parallelism never applies to the board-sized rollout net, so a
+    # real sp axis would otherwise sit idle (or worse, duplicate
+    # rollout work) during self-play.
+    sp_mesh = None
+    sp_axes: tuple = ()
+    if mesh.devices.size > 1:
+        sp_axes = rollout_lane_axes(
+            mesh, mesh_config.DP_AXIS, mesh_config.SP_AXIS
+        )
+        lane_shards = lane_shard_count(mesh, sp_axes)
+        if train_config.SELF_PLAY_BATCH_SIZE % lane_shards == 0:
+            sp_mesh = mesh
+            logger.info(
+                "Self-play lanes sharded over mesh axes %s (%d-way).",
+                sp_axes,
+                lane_shards,
+            )
+        else:
+            logger.warning(
+                "SELF_PLAY_BATCH_SIZE=%d does not divide the mesh's "
+                "%d lane shards %s; self-play stays on one device "
+                "(pick a divisible batch to fan rollouts across the "
+                "mesh).",
+                train_config.SELF_PLAY_BATCH_SIZE,
+                lane_shards,
+                sp_axes,
+            )
     self_play = SelfPlayEngine(
         env,
         extractor,
@@ -204,11 +239,17 @@ def setup_training_components(
         mcts_config,
         train_config,
         seed=train_config.RANDOM_SEED + 1,
+        mesh=sp_mesh,
+        data_axes=sp_axes or ("dp",),
     )
-    # TensorBoard is singleton host-side work: process 0 only.
+    # TensorBoard and the live-console JSONL are singleton host-side
+    # work: process 0 only (N processes appending one shared file would
+    # interleave diverging step/episode lines and corrupt `cli watch`'s
+    # windowed rates).
     stats = StatsCollector(
         persistence_config,
         use_tensorboard=use_tensorboard and is_primary(),
+        use_live_file=is_primary(),
     )
     checkpoints = CheckpointManager(persistence_config)
     all_configs = {

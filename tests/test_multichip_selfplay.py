@@ -1,0 +1,245 @@
+"""Multi-chip self-play: lockstep lanes sharded over the mesh.
+
+The TPU counterpart of the reference fanning self-play actors across
+hardware (`alphatriangle/training/worker_manager.py:39-75`): B games
+shard B/n per device over the mesh's data axes, one jitted chunk
+program spans the mesh. Lanes are independent, so the sharded engine
+must produce exactly the same games as the single-device engine with
+the same seed — that bit-parity is the core assertion here.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.config import MeshConfig, TrainConfig
+from alphatriangle_tpu.env.engine import TriangleEnv
+from alphatriangle_tpu.features.core import get_feature_extractor
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.rl import SelfPlayEngine
+
+
+@pytest.fixture(scope="module")
+def world(tiny_env_config, tiny_model_config, tiny_mcts_config):
+    env = TriangleEnv(tiny_env_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+    return env, fe, net, tiny_mcts_config
+
+
+def _train_cfg(**kw):
+    base = dict(
+        BATCH_SIZE=8,
+        BUFFER_CAPACITY=5000,
+        MIN_BUFFER_SIZE_TO_TRAIN=8,
+        USE_PER=False,
+        N_STEP_RETURNS=3,
+        GAMMA=0.9,
+        MAX_EPISODE_MOVES=50,
+        SELF_PLAY_BATCH_SIZE=8,
+        MAX_TRAINING_STEPS=100,
+        RUN_NAME="mc_sp_test",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _make(world, mesh=None, data_axes=("dp",), seed=7, **cfg_kw):
+    env, fe, net, mcts_cfg = world
+    tc = _train_cfg(**cfg_kw)
+    return SelfPlayEngine(
+        env,
+        fe,
+        net,
+        mcts_cfg,
+        tc,
+        seed=seed,
+        mesh=mesh,
+        data_axes=data_axes,
+    )
+
+
+class TestShardedRollout:
+    def test_lanes_span_every_device(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        engine = _make(world, mesh=mesh)
+        # Initial carry already sharded: 8 lanes -> 1 per device.
+        shards = engine.states.step_count.addressable_shards
+        devices = {s.device for s in shards}
+        assert len(devices) == 8
+        assert all(s.data.shape == (1,) for s in shards)
+        engine.play_chunk(4)
+        # Sharding survives the donated chunk dispatch.
+        shards = engine.states.step_count.addressable_shards
+        assert {s.device for s in shards} == devices
+
+    def test_parity_with_unsharded_engine(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        sharded = _make(world, mesh=mesh, seed=11)
+        plain = _make(world, mesh=None, seed=11)
+        rs = sharded.play_moves(8)
+        rp = plain.play_moves(8)
+        # Lane math is device-local; the sharded program must play the
+        # exact same games (same seeds, same kernels, no collectives).
+        assert rs.num_experiences == rp.num_experiences
+        np.testing.assert_allclose(rs.grid, rp.grid, atol=0, rtol=0)
+        np.testing.assert_allclose(
+            rs.policy_target, rp.policy_target, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            rs.value_target, rp.value_target, atol=1e-5
+        )
+        assert rs.episode_scores == rp.episode_scores
+        assert rs.episode_lengths == rp.episode_lengths
+
+    def test_dp_sp_axes_compose(self, world):
+        # Lanes ride (dp, sp) when the mesh has a real sp axis: rollouts
+        # must not leave sp-axis devices idle (setup.py wires this).
+        mesh = MeshConfig(DP_SIZE=2, MDL_SIZE=2, SP_SIZE=2).build_mesh()
+        engine = _make(world, mesh=mesh, data_axes=("dp", "sp"))
+        engine.play_chunk(4)
+        result = engine.harvest()
+        assert result.num_experiences >= 0
+        shards = engine.states.step_count.addressable_shards
+        # 4-way lane sharding (dp*sp), each shard replicated over mdl:
+        # every one of the 8 devices holds lanes and steps games.
+        assert len({s.device for s in shards}) == 8
+        assert all(s.data.shape == (2,) for s in shards)
+
+    def test_indivisible_batch_rejected(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        with pytest.raises(ValueError, match="divide"):
+            _make(world, mesh=mesh, SELF_PLAY_BATCH_SIZE=6, BATCH_SIZE=6)
+
+    def test_share_compiled_requires_same_mesh(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        primary = _make(world, mesh=mesh)
+        env, fe, net, mcts_cfg = world
+        with pytest.raises(ValueError, match="mesh"):
+            SelfPlayEngine(
+                env,
+                fe,
+                net,
+                mcts_cfg,
+                primary.config,
+                seed=8,
+                share_compiled=primary,
+                mesh=None,
+            )
+
+    def test_stream_shares_program_on_same_mesh(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        primary = _make(world, mesh=mesh)
+        env, fe, net, mcts_cfg = world
+        stream = SelfPlayEngine(
+            env,
+            fe,
+            net,
+            mcts_cfg,
+            primary.config,
+            seed=8,
+            share_compiled=primary,
+            mesh=mesh,
+        )
+        assert stream._chunk_fn is primary._chunk_fn
+        stream.play_chunk(2)
+        assert stream.harvest() is not None
+
+    def test_mesh_sharded_variables_pass_through(self, world):
+        # Trainer-sharded (replicated-on-mesh) weights must ride as-is:
+        # _place_variables may not reshard them (zero-copy sync path).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        engine = _make(world, mesh=mesh)
+        rep = NamedSharding(mesh, PartitionSpec())
+        placed_in = jax.device_put(engine.net.variables, rep)
+        placed_out = engine._place_variables(placed_in, version=0)
+        assert placed_out is placed_in
+
+    def test_unsharded_variables_replicated_once_per_version(self, world):
+        # A pre-first-sync run must not re-upload the full network
+        # every chunk: the replicated copy is memoized per version.
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        engine = _make(world, mesh=mesh)
+        placed_a = engine._place_variables(engine.net.variables, version=0)
+        placed_b = engine._place_variables(engine.net.variables, version=0)
+        assert placed_b is placed_a
+        placed_c = engine._place_variables(engine.net.variables, version=1)
+        assert placed_c is not placed_a
+
+
+class TestSetupWiring:
+    def test_setup_shards_when_divisible(self, tmp_path, tiny_env_config,
+                                         tiny_model_config, tiny_mcts_config):
+        from alphatriangle_tpu.config import PersistenceConfig
+        from alphatriangle_tpu.training import setup_training_components
+
+        c = setup_training_components(
+            train_config=_train_cfg(RUN_NAME="mc_setup"),
+            env_config=tiny_env_config,
+            model_config=tiny_model_config,
+            mcts_config=tiny_mcts_config,
+            persistence_config=PersistenceConfig(
+                ROOT_DATA_DIR=str(tmp_path), RUN_NAME="mc_setup"
+            ),
+            use_tensorboard=False,
+        )
+        # 8 lanes over the default dp=8 mesh of the 8 virtual devices.
+        assert c.self_play.mesh is not None
+        assert len(
+            {s.device for s in c.self_play.states.done.addressable_shards}
+        ) == 8
+        c.stats.close()
+        c.checkpoints.close()
+
+    def test_setup_falls_back_when_indivisible(
+        self, tmp_path, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        from alphatriangle_tpu.config import PersistenceConfig
+        from alphatriangle_tpu.training import setup_training_components
+
+        c = setup_training_components(
+            train_config=_train_cfg(
+                RUN_NAME="mc_setup2", SELF_PLAY_BATCH_SIZE=6
+            ),
+            env_config=tiny_env_config,
+            model_config=tiny_model_config,
+            mcts_config=tiny_mcts_config,
+            persistence_config=PersistenceConfig(
+                ROOT_DATA_DIR=str(tmp_path), RUN_NAME="mc_setup2"
+            ),
+            use_tensorboard=False,
+        )
+        assert c.self_play.mesh is None  # warned + single-device
+        c.stats.close()
+        c.checkpoints.close()
+
+
+class TestPlacedVariablesMemo:
+    def test_streams_share_one_replicated_copy(self, world):
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        primary = _make(world, mesh=mesh)
+        env, fe, net, mcts_cfg = world
+        stream = SelfPlayEngine(
+            env, fe, net, mcts_cfg, primary.config, seed=9,
+            share_compiled=primary, mesh=mesh,
+        )
+        a = primary._place_variables(net.variables, version=0)
+        b = stream._place_variables(net.variables, version=0)
+        assert b is a  # one upload for all streams
+
+    def test_memo_dropped_once_weights_are_mesh_sharded(self, world):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = MeshConfig(DP_SIZE=8).build_mesh()
+        engine = _make(world, mesh=mesh)
+        engine._place_variables(engine.net.variables, version=0)
+        assert engine._placed_owner._placed_variables is not None
+        sharded = jax.device_put(
+            engine.net.variables, NamedSharding(mesh, PartitionSpec())
+        )
+        out = engine._place_variables(sharded, version=1)
+        assert out is sharded
+        # The pre-sync replicated copy must not stay pinned in HBM.
+        assert engine._placed_owner._placed_variables is None
