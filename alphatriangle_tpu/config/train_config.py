@@ -84,6 +84,15 @@ class TrainConfig(BaseModel):
     # queue wait. None disables auto-tuning (dispatch
     # ROLLOUT_CHUNK_MOVES every time).
     ASYNC_CHUNK_SECONDS: float | None = Field(default=2.0, gt=0)
+    # Producer stream supervision: a crashed rollout stream is
+    # respawned with a fresh engine (carry + PRNG; compiled programs
+    # shared, so no recompile) after an exponential backoff, up to
+    # this many times per stream; exhausted, the run aborts with the
+    # original error. The reference detects dead actors and merely
+    # removes them (`worker_manager.py:153-159`) — SURVEY §7.9 asked
+    # for restart. 0 = abort on first crash.
+    PRODUCER_MAX_RESTARTS: int = Field(default=3, ge=0)
+    PRODUCER_RESTART_BACKOFF_S: float = Field(default=1.0, gt=0)
 
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)

@@ -67,6 +67,14 @@ class TrainingLoop:
         self.experiences_added = 0  # this run (resume-independent)
         self._steps_this_run = 0
         self._producer_error: BaseException | None = None
+        # Producer supervision (overlapped mode): crashed streams
+        # report here and the consumer respawns them with backoff —
+        # bounded retries, then the run aborts with the original error
+        # (the reference only removes dead actors and degrades,
+        # `worker_manager.py:153-159`; SURVEY §7.9 asked for restart).
+        self._producer_failures: "queue.Queue" = queue.Queue()
+        self._streams: dict[int, dict] = {}
+        self.producer_restarts = 0
         # Pipelined learner (overlapped mode): fused groups dispatched
         # but not yet fetched, oldest first. Each entry is
         # (trainer handle, samples list).
@@ -626,9 +634,101 @@ class TrainingLoop:
                             break
                         except queue.Full:
                             continue
-        except BaseException as exc:  # surface in the consumer thread
-            self._producer_error = exc
-            self.stop_event.set()
+        except BaseException as exc:
+            # Report to the supervisor (consumer thread), which
+            # respawns the stream with backoff or — retries exhausted —
+            # aborts the run with this error. Shutdown-time noise
+            # (threads interrupted mid-dispatch by stop_event) is not
+            # a crash.
+            if not self.stop_event.is_set():
+                self._producer_failures.put((stream, exc))
+
+    # --- producer supervision (overlapped mode) ---------------------------
+
+    def _spawn_producer_thread(
+        self, engine, harvests: "queue.Queue", stream: int
+    ) -> threading.Thread:
+        t = threading.Thread(
+            target=self._producer_loop,
+            args=(engine, harvests, stream),
+            name=f"self-play-producer-{stream}",
+            daemon=True,
+        )
+        t.start()
+        return t
+
+    def _fresh_stream_engine(self, stream: int, attempt: int):
+        """A replacement engine for a crashed stream: fresh carry and
+        PRNG stream (the crashed engine's donated buffers may be
+        invalidated mid-dispatch), compiled programs shared with the
+        primary — rollout programs depend only on configs, so the
+        respawn never recompiles."""
+        from ..rl.self_play import SelfPlayEngine
+
+        primary = self.c.self_play
+        return SelfPlayEngine(
+            primary.env,
+            primary.extractor,
+            primary.net,
+            primary.mcts_config,
+            primary.config,
+            seed=self.cfg.RANDOM_SEED + 2000 + stream * 100 + attempt,
+            share_compiled=primary,
+            mesh=primary.mesh,
+            data_axes=primary.data_axes,
+        )
+
+    def _supervise_producers(self, harvests: "queue.Queue") -> None:
+        """Respawn crashed producer streams with exponential backoff;
+        abort the run (original exception) once a stream exhausts
+        PRODUCER_MAX_RESTARTS."""
+        now = time.monotonic()
+        while True:
+            try:
+                stream, exc = self._producer_failures.get_nowait()
+            except queue.Empty:
+                break
+            rec = self._streams[stream]
+            if rec["restarts"] >= self.cfg.PRODUCER_MAX_RESTARTS:
+                logger.error(
+                    "Producer stream %d crashed and exhausted its %d "
+                    "restarts; aborting run.",
+                    stream,
+                    self.cfg.PRODUCER_MAX_RESTARTS,
+                )
+                self._producer_error = exc
+                self.stop_event.set()
+                return
+            delay = self.cfg.PRODUCER_RESTART_BACKOFF_S * (
+                2 ** rec["restarts"]
+            )
+            rec["restarts"] += 1
+            rec["retry_at"] = now + delay
+            logger.warning(
+                "Producer stream %d crashed (%s: %s); respawning in "
+                "%.2fs (restart %d/%d).",
+                stream,
+                type(exc).__name__,
+                exc,
+                delay,
+                rec["restarts"],
+                self.cfg.PRODUCER_MAX_RESTARTS,
+            )
+        for stream, rec in self._streams.items():
+            if rec.get("retry_at") is not None and now >= rec["retry_at"]:
+                rec["retry_at"] = None
+                rec["engine"] = self._fresh_stream_engine(
+                    stream, rec["restarts"]
+                )
+                rec["thread"] = self._spawn_producer_thread(
+                    rec["engine"], harvests, stream
+                )
+                self.producer_restarts += 1
+                self.c.stats.log_scalar(
+                    "System/Producer_Restarts",
+                    self.producer_restarts,
+                    self.global_step,
+                )
 
     def _learner_steps_allowed(self) -> int:
         """Replay-ratio gate: steps the learner may run this instant.
@@ -768,6 +868,8 @@ class TrainingLoop:
                     primary.config,
                     seed=self.cfg.RANDOM_SEED + 1000 + i,
                     share_compiled=primary,
+                    mesh=primary.mesh,
+                    data_axes=primary.data_axes,
                 )
             )
         return streams
@@ -800,17 +902,15 @@ class TrainingLoop:
             self._maybe_tune_chunk(
                 cfg.ROLLOUT_CHUNK_MOVES, dt, warmed=True
             )
-        producers = [
-            threading.Thread(
-                target=self._producer_loop,
-                args=(engine, harvests, i),
-                name=f"self-play-producer-{i}",
-                daemon=True,
-            )
+        self._streams = {
+            i: {
+                "engine": engine,
+                "thread": self._spawn_producer_thread(engine, harvests, i),
+                "restarts": 0,
+                "retry_at": None,
+            }
             for i, engine in enumerate(self._make_rollout_streams())
-        ]
-        for producer in producers:
-            producer.start()
+        }
         iteration = 0
         try:
             while not self.stop_event.is_set():
@@ -822,6 +922,7 @@ class TrainingLoop:
                     break
                 self.profile.on_iteration(iteration)
                 iteration += 1
+                self._supervise_producers(harvests)
                 # Drain everything available; block briefly only when
                 # there is no learner work to do either.
                 folded = 0
@@ -879,11 +980,11 @@ class TrainingLoop:
                 self._drain_learner()
             except Exception:
                 logger.exception("Draining inflight learner groups failed.")
-            for producer in producers:
-                producer.join(timeout=30.0)
-                if producer.is_alive():
+            for rec in self._streams.values():
+                rec["thread"].join(timeout=30.0)
+                if rec["thread"].is_alive():
                     logger.warning(
-                        "%s did not join within 30s.", producer.name
+                        "%s did not join within 30s.", rec["thread"].name
                     )
             # Fold any harvests still queued so the final checkpoint /
             # buffer spill includes everything that was actually played.
