@@ -328,6 +328,61 @@ def cmd_ml(args: argparse.Namespace) -> int:
     )
 
 
+def cmd_watch(args: argparse.Namespace) -> int:
+    """Live-run console: tail a run's `live_metrics.jsonl` and render
+    games/h, learner steps/s, replay ratio, staleness, queue depth —
+    the observability the reference served via its Ray dashboard +
+    MLflow UI (`alphatriangle/cli.py:301-326`). Never imports JAX, so
+    it is safe to run beside a training process on a sick-chip day."""
+    import time as _time
+
+    from .config import PersistenceConfig
+    from .stats.watch import (
+        WatchState,
+        find_latest_run_dir,
+        render_frame,
+        tail_live_metrics,
+    )
+
+    persistence = PersistenceConfig(RUN_NAME=args.run_name or "latest")
+    if args.root_dir:
+        persistence = persistence.model_copy(
+            update={"ROOT_DATA_DIR": args.root_dir}
+        )
+    if args.run_name:
+        run_dir = persistence.get_run_base_dir()
+    else:
+        run_dir = find_latest_run_dir(persistence.get_runs_root_dir())
+        if run_dir is None:
+            print(
+                f"no runs under {persistence.get_runs_root_dir()}",
+                file=sys.stderr,
+            )
+            return 1
+    live = run_dir / "live_metrics.jsonl"
+    state = WatchState()
+    offset = tail_live_metrics(live, state, 0)
+    if not live.exists():
+        print(
+            f"waiting for {live} (run still starting?) — Ctrl-C to stop",
+            file=sys.stderr,
+        )
+    frame = render_frame(state, run_dir.name)
+    print(frame, flush=True)
+    if args.once:
+        return 0
+    try:
+        while True:
+            _time.sleep(args.interval)
+            offset = tail_live_metrics(live, state, offset)
+            # Redraw in place: move up over the previous frame.
+            height = frame.count("\n") + 1
+            frame = render_frame(state, run_dir.name)
+            print(f"\x1b[{height}F\x1b[0J" + frame, flush=True)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_devices(_args: argparse.Namespace) -> int:
     import jax
 
@@ -372,6 +427,14 @@ def cmd_eval(args: argparse.Namespace) -> int:
     from .nn.network import NeuralNetwork
     from .rl import Trainer
     from .stats.persistence import CheckpointManager
+    from .utils.helpers import enable_persistent_compilation_cache
+
+    # Backend resolves on first device use below anyway; with it known
+    # the compile cache gates correctly (eval compiles the same
+    # flagship search programs training does — ~70s each cold).
+    import jax
+
+    enable_persistent_compilation_cache(backend=jax.default_backend())
 
     def run_base_dir(run_name: str):
         persistence = PersistenceConfig(RUN_NAME=run_name)
@@ -730,8 +793,12 @@ def cmd_tune(args: argparse.Namespace) -> int:
     from .features.core import get_feature_extractor
     from .nn.network import NeuralNetwork
     from .rl import SelfPlayEngine
+    from .utils.helpers import enable_persistent_compilation_cache
 
     backend = jax.default_backend()
+    # Backend now resolved: safe to gate the persistent compile cache
+    # correctly (an auto run that landed on CPU must not cache).
+    enable_persistent_compilation_cache(backend=backend)
     env_cfg = EnvConfig()
     model_cfg = ModelConfig(
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
@@ -814,6 +881,19 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("devices", help="Show the JAX backend and devices.")
 
+    watch = sub.add_parser(
+        "watch",
+        help="Live console for a training run (tails live_metrics.jsonl).",
+    )
+    watch.add_argument(
+        "--run-name", default=None, help="Default: most recent run."
+    )
+    watch.add_argument("--root-dir", default=None)
+    watch.add_argument("--interval", type=float, default=2.0)
+    watch.add_argument(
+        "--once", action="store_true", help="Render one frame and exit."
+    )
+
     an = sub.add_parser(
         "analyze", help="Summarize per-phase timer dumps from a profile run."
     )
@@ -889,6 +969,7 @@ def main(argv: list[str] | None = None) -> int:
         "tb": cmd_tb,
         "ml": cmd_ml,
         "devices": cmd_devices,
+        "watch": cmd_watch,
         "analyze": cmd_analyze,
         "eval": cmd_eval,
         "play": cmd_play,

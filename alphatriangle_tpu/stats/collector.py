@@ -17,6 +17,7 @@ from this environment; the writer degrades to TensorBoard-only
 import json
 import logging
 import threading
+import time
 from collections import defaultdict, deque
 from pathlib import Path
 
@@ -53,6 +54,7 @@ class StatsCollector:
         use_tensorboard: bool = True,
         log_dir: str | Path | None = None,
         history_limit: int = 1024,
+        use_live_file: bool = True,
     ):
         self._lock = threading.Lock()
         self._pending: dict[str, list[tuple[int, float]]] = defaultdict(list)
@@ -71,6 +73,15 @@ class StatsCollector:
             if tb_dir is not None:
                 tb_dir.mkdir(parents=True, exist_ok=True)
                 self._writer = SummaryWriter(str(tb_dir))
+        # Live-console channel (`cli watch`): one JSON line per tick in
+        # the run dir, readable by a process that never touches JAX —
+        # the run-dir-tail observability the reference served through
+        # its Ray dashboard + MLflow UI (`alphatriangle/cli.py:301-326`).
+        self._live_path: Path | None = None
+        if use_live_file and persistence is not None:
+            base = persistence.get_run_base_dir()
+            base.mkdir(parents=True, exist_ok=True)
+            self._live_path = base / "live_metrics.jsonl"
         self._mlflow = None
         self._mlflow_run = None
         uri = persistence.MLFLOW_TRACKING_URI if persistence else None
@@ -131,6 +142,21 @@ class StatsCollector:
                 self._writer.add_scalar(name, mean, global_step)
         if self._writer is not None and means:
             self._writer.flush()
+        if self._live_path is not None and means:
+            try:
+                with self._live_path.open("a") as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "step": global_step,
+                                "time": time.time(),
+                                "means": means,
+                            }
+                        )
+                        + "\n"
+                    )
+            except OSError:  # observability is never fatal
+                logger.exception("live-metrics append failed")
         if self._mlflow is not None and means:
             try:
                 self._mlflow.log_metrics(

@@ -1,0 +1,182 @@
+"""Live-run console: tail a run's `live_metrics.jsonl` and render rates.
+
+The reference ships a dashboard path for watching a run (Ray dashboard
++ MLflow UI as first-class CLI concerns, `alphatriangle/cli.py:301-326`,
+its `README.md:63-79`). Here the equivalent is file-shaped: the
+`StatsCollector` appends one JSON line per aggregation tick to the run
+dir, and `cli watch` tails it from any shell — including one on a
+laptop reading a mounted/rsynced run dir — without importing JAX or
+touching the (possibly wedged) accelerator.
+
+Pure functions + a small folding state so the rendering is unit-testable
+without a live run.
+"""
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# Window over which rates (games/h, steps/s) are computed: long enough
+# to smooth chunked arrivals, short enough to track a run going sick.
+RATE_WINDOW_S = 120.0
+
+
+@dataclass
+class WatchState:
+    """Folds live-metric ticks; exposes latest values + windowed rates."""
+
+    latest: dict = field(default_factory=dict)
+    latest_step: int = 0
+    latest_time: float = 0.0
+    # (wall time, step, cumulative episodes) samples for rate windows.
+    _samples: deque = field(default_factory=lambda: deque(maxlen=512))
+
+    def fold_line(self, line: str) -> bool:
+        """Fold one JSONL line; returns False for junk (torn writes)."""
+        line = line.strip()
+        if not line:
+            return False
+        try:
+            tick = json.loads(line)
+            step = int(tick["step"])
+            wall = float(tick["time"])
+            means = tick["means"]
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return False
+        self.latest.update(means)
+        self.latest_step = step
+        self.latest_time = wall
+        self._samples.append(
+            (wall, step, means.get("Progress/Episodes_Played"))
+        )
+        return True
+
+    def _window(self) -> "tuple | None":
+        """(oldest, newest) samples spanning <= RATE_WINDOW_S, or None."""
+        if len(self._samples) < 2:
+            return None
+        newest = self._samples[-1]
+        oldest = None
+        for s in self._samples:
+            if newest[0] - s[0] <= RATE_WINDOW_S:
+                oldest = s
+                break
+        if oldest is None or newest[0] <= oldest[0]:
+            return None
+        return oldest, newest
+
+    @property
+    def steps_per_sec(self) -> "float | None":
+        w = self._window()
+        if w is None:
+            return None
+        (t0, s0, _), (t1, s1, _) = w
+        return (s1 - s0) / (t1 - t0)
+
+    @property
+    def games_per_hour(self) -> "float | None":
+        # The collector flushes only metrics logged since the last
+        # tick, so learner-only ticks carry no episode count; take the
+        # oldest/newest samples IN the window that have one, not the
+        # literal endpoints — otherwise the headline rate flaps to "—"
+        # whenever a learner-dominated tick lands last.
+        if len(self._samples) < 2:
+            return None
+        newest_t = self._samples[-1][0]
+        with_eps = [
+            s
+            for s in self._samples
+            if s[2] is not None and newest_t - s[0] <= RATE_WINDOW_S
+        ]
+        if len(with_eps) < 2:
+            return None
+        (t0, _, e0), (t1, _, e1) = with_eps[0], with_eps[-1]
+        if t1 <= t0:
+            return None
+        return (e1 - e0) * 3600.0 / (t1 - t0)
+
+    @property
+    def age_seconds(self) -> "float | None":
+        """Seconds since the last tick (stall indicator)."""
+        if not self.latest_time:
+            return None
+        return max(0.0, time.time() - self.latest_time)
+
+
+def _fmt(value: "float | None", spec: str = ",.1f", unit: str = "") -> str:
+    if value is None:
+        return "—"
+    return f"{value:{spec}}{unit}"
+
+
+def render_frame(state: WatchState, run_name: str) -> str:
+    """One console frame: the run's vital signs, newest tick first."""
+    m = state.latest
+    age = state.age_seconds
+    stale = age is not None and age > 300
+    lines = [
+        f"run {run_name} @ step {state.latest_step:,}"
+        + (
+            f"   (last tick {_fmt(age, ',.0f', 's')} ago"
+            + (" — STALLED?)" if stale else ")")
+            if age is not None
+            else ""
+        ),
+        "",
+        f"  self-play    {_fmt(state.games_per_hour, ',.0f')} games/h"
+        f"   episodes {_fmt(m.get('Progress/Episodes_Played'), ',.0f')}"
+        f"   score {_fmt(m.get('SelfPlay/Episode_Score'), ',.2f')}"
+        f"   len {_fmt(m.get('SelfPlay/Episode_Length'), ',.1f')}",
+        f"  learner      {_fmt(state.steps_per_sec, ',.2f')} steps/s"
+        f"   loss {_fmt(m.get('Loss/total_loss'), ',.4f')}"
+        f"   grad-norm {_fmt(m.get('Loss/Grad_Norm'), ',.3f')}",
+        f"  replay       ratio {_fmt(m.get('System/Replay_Ratio_Actual'), ',.3f')}"
+        f"   buffer {_fmt(m.get('Buffer/Size'), ',.0f')}"
+        f"   staleness {_fmt(m.get('SelfPlay/Staleness_Steps'), ',.1f')} steps",
+        f"  pipeline     queue {_fmt(m.get('System/Rollout_Queue_Depth'), ',.1f')}"
+        f"   producer restarts {_fmt(m.get('System/Producer_Restarts'), ',.0f')}"
+        f"   full-search {_fmt(m.get('SelfPlay/Full_Search_Fraction'), ',.2f')}",
+    ]
+    return "\n".join(lines)
+
+
+def tail_live_metrics(
+    path: Path,
+    state: WatchState,
+    offset: int = 0,
+) -> int:
+    """Fold lines appended past `offset`; returns the new offset.
+
+    Tolerates the file not existing yet (run still compiling) and a
+    torn final line (reread next tick)."""
+    try:
+        size = path.stat().st_size
+    except OSError:
+        return offset
+    if size <= offset:
+        # Truncated (fresh run reusing the dir) — start over.
+        return 0 if size < offset else offset
+    with path.open("r") as f:
+        f.seek(offset)
+        chunk = f.read()
+    # Keep a torn trailing line for the next read.
+    end = chunk.rfind("\n")
+    if end < 0:
+        return offset
+    for line in chunk[: end + 1].splitlines():
+        state.fold_line(line)
+    return offset + end + 1
+
+
+def find_latest_run_dir(runs_root: Path) -> "Path | None":
+    """Most recently modified run dir under the runs root (host-side
+    twin of CheckpointManager.find_latest_run, importable without JAX)."""
+    try:
+        candidates = [p for p in runs_root.iterdir() if p.is_dir()]
+    except OSError:
+        return None
+    if not candidates:
+        return None
+    return max(candidates, key=lambda p: p.stat().st_mtime)

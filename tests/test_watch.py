@@ -1,0 +1,204 @@
+"""Live-run console tests (`cli watch` + stats/watch.py) — the
+run-dir-tail observability replacing the reference's Ray dashboard
+path (`alphatriangle/cli.py:301-326`)."""
+
+import json
+import time
+
+from alphatriangle_tpu import cli
+from alphatriangle_tpu.stats.watch import (
+    WatchState,
+    find_latest_run_dir,
+    render_frame,
+    tail_live_metrics,
+)
+
+
+def tick(step, t, **means):
+    return json.dumps({"step": step, "time": t, "means": means})
+
+
+class TestWatchState:
+    def test_rates_from_window(self):
+        s = WatchState()
+        t0 = time.time() - 60
+        assert s.fold_line(
+            tick(0, t0, **{"Progress/Episodes_Played": 100.0})
+        )
+        assert s.fold_line(
+            tick(30, t0 + 60, **{"Progress/Episodes_Played": 220.0})
+        )
+        # 30 steps / 60 s; 120 episodes / 60 s -> 7200 games/h.
+        assert abs(s.steps_per_sec - 0.5) < 1e-6
+        assert abs(s.games_per_hour - 7200.0) < 1e-3
+        assert s.latest_step == 30
+
+    def test_junk_lines_ignored(self):
+        s = WatchState()
+        assert not s.fold_line("")
+        assert not s.fold_line("{torn json")
+        assert not s.fold_line('{"no_step": 1}')
+        assert s.latest == {}
+
+    def test_single_tick_has_no_rates(self):
+        s = WatchState()
+        s.fold_line(tick(5, time.time(), **{"Buffer/Size": 10.0}))
+        assert s.steps_per_sec is None
+        assert s.games_per_hour is None
+        assert s.latest["Buffer/Size"] == 10.0
+
+    def test_render_frame_shows_vitals(self):
+        s = WatchState()
+        t0 = time.time() - 10
+        s.fold_line(
+            tick(
+                0,
+                t0,
+                **{
+                    "Progress/Episodes_Played": 0.0,
+                    "Loss/total_loss": 2.5,
+                },
+            )
+        )
+        s.fold_line(
+            tick(
+                20,
+                t0 + 10,
+                **{
+                    "Progress/Episodes_Played": 50.0,
+                    "Loss/total_loss": 1.25,
+                    "System/Replay_Ratio_Actual": 0.97,
+                },
+            )
+        )
+        frame = render_frame(s, "my_run")
+        assert "my_run" in frame and "step 20" in frame
+        assert "1.2500" in frame  # loss
+        assert "0.970" in frame  # replay ratio
+        assert "games/h" in frame and "steps/s" in frame
+
+
+class TestTail:
+    def test_incremental_tail_and_torn_line(self, tmp_path):
+        live = tmp_path / "live_metrics.jsonl"
+        s = WatchState()
+        assert tail_live_metrics(live, s, 0) == 0  # not yet created
+        live.write_text(tick(1, 1000.0, **{"Buffer/Size": 1.0}) + "\n")
+        off = tail_live_metrics(live, s, 0)
+        assert s.latest_step == 1 and off == live.stat().st_size
+        # Torn write: no newline yet -> held back, then folded.
+        with live.open("a") as f:
+            f.write(tick(2, 1001.0, **{"Buffer/Size": 2.0})[:10])
+        assert tail_live_metrics(live, s, off) == off
+        assert s.latest_step == 1
+        with live.open("a") as f:
+            f.write(tick(2, 1001.0, **{"Buffer/Size": 2.0})[10:] + "\n")
+        off = tail_live_metrics(live, s, off)
+        assert s.latest_step == 2 and s.latest["Buffer/Size"] == 2.0
+
+    def test_truncation_restarts(self, tmp_path):
+        live = tmp_path / "live_metrics.jsonl"
+        live.write_text(tick(1, 1.0) + "\n" + tick(2, 2.0) + "\n")
+        s = WatchState()
+        off = tail_live_metrics(live, s, 0)
+        live.write_text(tick(1, 3.0) + "\n")  # fresh run, same dir
+        assert tail_live_metrics(live, s, off) == 0
+
+    def test_find_latest_run_dir(self, tmp_path):
+        (tmp_path / "runs").mkdir()
+        a = tmp_path / "runs" / "old_run"
+        b = tmp_path / "runs" / "new_run"
+        a.mkdir()
+        b.mkdir()
+        import os
+
+        os.utime(a, (1, 1))
+        assert find_latest_run_dir(tmp_path / "runs") == b
+        assert find_latest_run_dir(tmp_path / "missing") is None
+
+
+class TestCollectorLiveFile:
+    def test_ticks_append_jsonl(self, tmp_path):
+        from alphatriangle_tpu.config import PersistenceConfig
+        from alphatriangle_tpu.stats.collector import StatsCollector
+
+        pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="lr")
+        col = StatsCollector(pc, use_tensorboard=False)
+        col.log_scalar("Buffer/Size", 5.0, step=1)
+        col.process_and_log(1)
+        col.log_scalar("Buffer/Size", 7.0, step=2)
+        col.process_and_log(2)
+        col.close()
+        live = pc.get_run_base_dir() / "live_metrics.jsonl"
+        lines = [
+            json.loads(x) for x in live.read_text().splitlines() if x
+        ]
+        assert [x["step"] for x in lines] == [1, 2]
+        assert lines[1]["means"]["Buffer/Size"] == 7.0
+
+    def test_opt_out(self, tmp_path):
+        from alphatriangle_tpu.config import PersistenceConfig
+        from alphatriangle_tpu.stats.collector import StatsCollector
+
+        pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME="lr2")
+        col = StatsCollector(pc, use_tensorboard=False, use_live_file=False)
+        col.log_scalar("Buffer/Size", 5.0, step=1)
+        col.process_and_log(1)
+        col.close()
+        assert not (pc.get_run_base_dir() / "live_metrics.jsonl").exists()
+
+
+class TestCliWatch:
+    def test_once_renders_run(self, tmp_path, capsys):
+        run = tmp_path / "AlphaTriangleTPU" / "runs" / "w_run"
+        run.mkdir(parents=True)
+        (run / "live_metrics.jsonl").write_text(
+            tick(7, time.time(), **{"Buffer/Size": 11.0}) + "\n"
+        )
+        rc = cli.main(
+            [
+                "watch",
+                "--run-name",
+                "w_run",
+                "--root-dir",
+                str(tmp_path),
+                "--once",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "w_run" in out and "step 7" in out
+
+    def test_defaults_to_latest_run(self, tmp_path, capsys):
+        runs = tmp_path / "AlphaTriangleTPU" / "runs"
+        (runs / "older").mkdir(parents=True)
+        newer = runs / "newer"
+        newer.mkdir()
+        import os
+
+        os.utime(runs / "older", (1, 1))
+        (newer / "live_metrics.jsonl").write_text(
+            tick(3, time.time()) + "\n"
+        )
+        rc = cli.main(
+            ["watch", "--root-dir", str(tmp_path), "--once"]
+        )
+        assert rc == 0
+        assert "newer" in capsys.readouterr().out
+
+    def test_no_runs_errors(self, tmp_path, capsys):
+        rc = cli.main(["watch", "--root-dir", str(tmp_path), "--once"])
+        assert rc == 1
+
+
+class TestRateRobustness:
+    def test_learner_only_tick_does_not_flap_games_rate(self):
+        # Ticks without Progress/Episodes_Played (learner-dominated)
+        # must not null the games/h headline.
+        s = WatchState()
+        t0 = time.time() - 90
+        s.fold_line(tick(0, t0, **{"Progress/Episodes_Played": 0.0}))
+        s.fold_line(tick(10, t0 + 60, **{"Progress/Episodes_Played": 120.0}))
+        s.fold_line(tick(12, t0 + 90, **{"Loss/total_loss": 1.0}))
+        assert abs(s.games_per_hour - 7200.0) < 1e-3
+        assert s.latest_step == 12
