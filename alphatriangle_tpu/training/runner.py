@@ -22,7 +22,10 @@ from ..parallel.distributed import (
     is_primary,
 )
 from ..stats.persistence import CheckpointManager
-from ..utils.helpers import enforce_platform
+from ..utils.helpers import (
+    enable_persistent_compilation_cache,
+    enforce_platform,
+)
 from .loop import LoopStatus, TrainingLoop
 from .setup import setup_training_components
 
@@ -91,6 +94,13 @@ def run_training(
     train_config, persistence_config = _resolve_auto_resume(
         train_config, persistence_config
     )
+    # Backend resolves here anyway (setup compiles programs next); with
+    # it known, the persistent compile cache can be gated correctly —
+    # an auto run that landed on CPU must NOT cache (XLA:CPU AOT
+    # reloads carry a SIGILL risk), an accelerator run should.
+    import jax
+
+    enable_persistent_compilation_cache(backend=jax.default_backend())
 
     try:
         components = setup_training_components(

@@ -30,12 +30,18 @@ def enforce_platform(device: str = "auto") -> None:
         jax.config.update("jax_platforms", "cpu")
     # Every runtime entry point passes through here, so it doubles as
     # the hook for the cross-process executable cache; the helper
-    # itself skips CPU runs and honors the opt-out env.
-    enable_persistent_compilation_cache()
+    # itself skips CPU runs, defers when the backend is still unknown
+    # (entry points re-call it with the resolved backend), and honors
+    # the opt-out env. An explicit accelerator request counts as a
+    # known backend.
+    enable_persistent_compilation_cache(
+        backend=device if device in ("tpu", "gpu") else None
+    )
 
 
 def enable_persistent_compilation_cache(
     cache_dir: str | None = None,
+    backend: str | None = None,
 ) -> None:
     """Cache compiled XLA executables on disk across processes.
 
@@ -50,19 +56,28 @@ def enable_persistent_compilation_cache(
     ACCELERATOR BACKENDS ONLY: XLA:CPU's cached AOT results record
     compile-time tuning pseudo-features (`+prefer-no-scatter`, ...)
     that fail the host feature check on reload, logging SIGILL-risk
-    errors — and CPU compiles are cheap anyway. The gate lives here:
-    a run whose platform is pinned to cpu (env or config — the
-    `enforce_platform` pattern, used by every CPU entry point and the
-    test conftest) is skipped, without touching backend init.
+    errors — and CPU compiles are cheap anyway. The gate: callers that
+    already know the resolved backend pass it via `backend` (skipped on
+    'cpu'); without it, a run whose platform is pinned to cpu (env or
+    config — the `enforce_platform` pattern) is skipped, and an
+    *unpinned* auto run is DEFERRED — an auto run on a CPU-only host
+    resolves to the CPU backend, exactly the AOT-reload path the gate
+    exists to prevent, so entry points re-call this with
+    `backend=jax.default_backend()` once the backend is live.
     """
     if os.environ.get("ALPHATRIANGLE_NO_COMPILE_CACHE") == "1":
         return  # operator opt-out (e.g. suspected stale/corrupt cache)
-    platforms = (
-        os.environ.get("JAX_PLATFORMS", "")
-        or str(getattr(jax.config, "jax_platforms", None) or "")
-    ).strip().lower()
-    if platforms == "cpu":
-        return
+    if backend is not None:
+        if backend.strip().lower() == "cpu":
+            return
+    else:
+        platforms = (
+            os.environ.get("JAX_PLATFORMS", "")
+            or str(getattr(jax.config, "jax_platforms", None) or "")
+        ).strip().lower()
+        if platforms == "cpu" or not platforms:
+            # Pinned cpu, or unpinned (backend unknown): skip/defer.
+            return
     path = (
         cache_dir
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")

@@ -3,6 +3,11 @@
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "extra": {...}}
 
+(The supervised measurement CHILD additionally streams cumulative
+snapshot lines tagged `extra.partial` as each section completes; the
+supervisor consumes those internally — keeping the newest if the chip
+wedges mid-run — and still prints exactly one line.)
+
 Primary metric: **self-play games/hour**, measured directly (episodes
 completed / wall-clock) with the flagship configuration - default 8x15
 board, conv+residual+transformer net, 64-sim batched MCTS - on one
@@ -71,11 +76,24 @@ def install_signal_forwarding() -> None:
     import signal
 
     def _forward(signum, frame):
+        # TERM first: the child's own SIGTERM handler converts it to a
+        # clean interpreter exit, giving PJRT its chip teardown — the
+        # orphan-wedge scenario this forwarding exists to mitigate.
+        # Only escalate to KILL after a short bounded wait.
         for child in list(_live_children):
             try:
-                child.kill()
+                child.terminate()
             except Exception:
                 pass
+        deadline = time.time() + 10.0
+        for child in list(_live_children):
+            try:
+                child.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                try:
+                    child.kill()
+                except Exception:
+                    pass
         raise SystemExit(128 + signum)
 
     signal.signal(signal.SIGTERM, _forward)
@@ -124,9 +142,19 @@ def probe_accelerator(timeout_s: float) -> "str | None":
         log(f"bench: accelerator probe timed out after {timeout_s:.0f}s")
         proc.kill()
         try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            pass
+            # Per subprocess docs: after the kill, re-invoke
+            # communicate() to reap the process AND release the PIPE
+            # fds + reader threads — a wedged chip retries this path
+            # up to BENCH_INIT_BUDGET/BENCH_INIT_TIMEOUT times per
+            # run, so each leak would compound.
+            proc.communicate(timeout=30)
+        except Exception:
+            for stream in (proc.stdout, proc.stderr):
+                try:
+                    if stream:
+                        stream.close()
+                except Exception:
+                    pass
         return None
     finally:
         _live_children.remove(proc)
@@ -208,10 +236,11 @@ def run_bench(smoke: bool, seconds: float) -> dict:
 
     backend = jax.default_backend()
     # The flagship programs cost ~70s each to compile on the tunneled
-    # chip; sweep sections repeat them. Cache executables across runs
-    # (the helper itself skips cpu-pinned runs — XLA:CPU AOT reloads
-    # carry a SIGILL risk).
-    enable_persistent_compilation_cache()
+    # chip; sweep sections repeat them. Cache executables across runs.
+    # The backend is resolved at this point, so pass it: the helper
+    # must skip CPU (XLA:CPU AOT reloads carry a SIGILL risk) even when
+    # an auto run landed there without a pinned platform.
+    enable_persistent_compilation_cache(backend=backend)
     device = jax.devices()[0]
     log(
         "bench: backend="
@@ -443,6 +472,73 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         f"{leaf_evals_per_sec:.0f} leaf-evals/s"
     )
 
+    # Result assembled incrementally; after each completed section the
+    # child emits a cumulative SNAPSHOT line tagged extra.partial, so a
+    # chip that wedges mid-run still leaves the sections that finished
+    # on the supervisor's pipe (the supervisor keeps the LAST parseable
+    # line; it only early-stops on a final, untagged one). The flagship
+    # games/h — the headline — therefore lands ~BENCH_SECONDS after
+    # first compile no matter what the later sections do.
+    north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)
+    from alphatriangle_tpu.utils.flops import (
+        forward_flops,
+        mfu,
+        peak_bf16_tflops,
+        train_step_flops,
+    )
+
+    device_kind = str(getattr(device, "device_kind", backend))
+    fwd = forward_flops(model_cfg, env_cfg, env_cfg.action_dim)
+    sp_flops_s = leaf_evals_per_sec * fwd
+    extra = {
+        "backend": backend,
+        "scale": scale,
+        "search_recipe": {
+            "root_selection": mcts_cfg.root_selection,
+            "fast_simulations": mcts_cfg.fast_simulations,
+            "full_search_prob": mcts_cfg.full_search_prob,
+        },
+        "descent_gather": mcts_cfg.descent_gather,
+        "self_play_batch": sp_batch,
+        "mcts_simulations": sims,
+        "rollout_chunk_moves": chunk,
+        "episodes_completed": episodes,
+        "measure_seconds": round(elapsed, 1),
+        "mean_episode_length": (
+            round(float(np.mean(result.episode_lengths)), 1)
+            if result.episode_lengths
+            else None
+        ),
+        "moves_per_sec": round(moves_per_sec, 1),
+        "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
+        "first_chunk_compile_seconds": round(compile_s, 1),
+        "device_kind": device_kind,
+        "flops": {
+            "forward_flops_per_eval": fwd,
+            "peak_bf16_tflops": peak_bf16_tflops(device_kind),
+            "self_play_tflops_per_sec": round(sp_flops_s / 1e12, 3),
+            "self_play_mfu": (
+                round(m, 4) if (m := mfu(sp_flops_s, device_kind)) else None
+            ),
+        },
+    }
+
+    def snapshot(partial: "str | None") -> dict:
+        global _last_partial
+        r = {
+            "metric": "self_play_games_per_hour",
+            "value": round(games_per_hour, 1),
+            "unit": "games/hour",
+            "vs_baseline": round(games_per_hour / north_star, 4),
+            "extra": json.loads(json.dumps(extra)),  # deep copy
+        }
+        if partial:
+            r["extra"]["partial"] = partial
+            _last_partial = r
+        return r
+
+    emit(snapshot("self_play"))
+
     # --- learner steps/sec (secondary) ----------------------------------
     trainer = Trainer(net, train_cfg)
     b = train_cfg.BATCH_SIZE
@@ -486,6 +582,26 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         f"bench: fused learner {fused_steps_per_sec:.2f} steps/s "
         f"(batch {b}, K={fused_k})"
     )
+    step_flops = train_step_flops(model_cfg, env_cfg, env_cfg.action_dim, b)
+    ln_flops_s = fused_steps_per_sec * step_flops
+    extra.update(
+        {
+            "learner_steps_per_sec": round(learner_steps_per_sec, 2),
+            "learner_steps_per_sec_fused": round(fused_steps_per_sec, 2),
+            "fused_group_size": fused_k,
+            "learner_batch": b,
+        }
+    )
+    extra["flops"].update(
+        {
+            "train_flops_per_step": step_flops,
+            "learner_tflops_per_sec": round(ln_flops_s / 1e12, 3),
+            "learner_mfu": (
+                round(m, 4) if (m := mfu(ln_flops_s, device_kind)) else None
+            ),
+        }
+    )
+    emit(snapshot("learner"))
 
     # Device-resident replay (rl/device_buffer.py): batches are gathered
     # on device from sampled indices, so a fused group uploads ~K*B*4
@@ -536,6 +652,17 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             f"bench: device-replay learner {dev_steps_per_sec:.2f} steps/s "
             f"(batch {b}, K={fused_k}, index-only uploads)"
         )
+        extra["learner_steps_per_sec_device_replay"] = round(
+            dev_steps_per_sec, 2
+        )
+        extra["flops"]["learner_device_replay_mfu"] = (
+            round(m, 4)
+            if (m := mfu(dev_steps_per_sec * step_flops, device_kind))
+            else None
+        )
+        emit(snapshot("device_replay"))
+    else:
+        extra["learner_steps_per_sec_device_replay"] = None
 
     # --- overlapped producer/consumer (combined rates) ------------------
     # The phases above run each side alone; this measures both at once
@@ -690,84 +817,15 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     if produced["errors"]:
         overlapped["producer_errors"] = produced["errors"]
     log(f"bench: overlapped {overlapped}")
+    extra["overlapped"] = overlapped
+    log(f"bench: flops/mfu {extra['flops']}")
+    return snapshot(None)
 
-    # --- FLOPs / MFU accounting -----------------------------------------
-    # Analytic matmul FLOPs (utils/flops.py): how much of the chip's
-    # bf16 peak each section actually used. Self-play counts network
-    # leaf evals only (descent bookkeeping — including the einsum
-    # gather's burned FLOPs — is excluded: MFU measures USEFUL model
-    # FLOPs); the learner counts fwd+bwd(+remat).
-    from alphatriangle_tpu.utils.flops import (
-        forward_flops,
-        mfu,
-        peak_bf16_tflops,
-        train_step_flops,
-    )
 
-    device_kind = str(getattr(device, "device_kind", backend))
-    fwd = forward_flops(model_cfg, env_cfg, env_cfg.action_dim)
-    sp_flops_s = leaf_evals_per_sec * fwd
-    step_flops = train_step_flops(model_cfg, env_cfg, env_cfg.action_dim, b)
-    ln_flops_s = fused_steps_per_sec * step_flops
-    flops_extra = {
-        "forward_flops_per_eval": fwd,
-        "train_flops_per_step": step_flops,
-        "peak_bf16_tflops": peak_bf16_tflops(device_kind),
-        "self_play_tflops_per_sec": round(sp_flops_s / 1e12, 3),
-        "self_play_mfu": (
-            round(m, 4) if (m := mfu(sp_flops_s, device_kind)) else None
-        ),
-        "learner_tflops_per_sec": round(ln_flops_s / 1e12, 3),
-        "learner_mfu": (
-            round(m, 4) if (m := mfu(ln_flops_s, device_kind)) else None
-        ),
-    }
-    log(f"bench: flops/mfu {flops_extra}")
-
-    north_star = 10_000.0  # games/hour, BASELINE.json north star (v4-8)
-    return {
-        "metric": "self_play_games_per_hour",
-        "value": round(games_per_hour, 1),
-        "unit": "games/hour",
-        "vs_baseline": round(games_per_hour / north_star, 4),
-        "extra": {
-            "backend": backend,
-            "scale": scale,
-            "search_recipe": {
-                "root_selection": mcts_cfg.root_selection,
-                "fast_simulations": mcts_cfg.fast_simulations,
-                "full_search_prob": mcts_cfg.full_search_prob,
-            },
-            "descent_gather": mcts_cfg.descent_gather,
-            "self_play_batch": sp_batch,
-            "mcts_simulations": sims,
-            "rollout_chunk_moves": chunk,
-            "episodes_completed": episodes,
-            "measure_seconds": round(elapsed, 1),
-            "mean_episode_length": (
-                round(float(np.mean(result.episode_lengths)), 1)
-                if result.episode_lengths
-                else None
-            ),
-            "moves_per_sec": round(moves_per_sec, 1),
-            "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
-            "learner_steps_per_sec": round(learner_steps_per_sec, 2),
-            "learner_steps_per_sec_fused": round(fused_steps_per_sec, 2),
-            # Device-resident replay ring (index-only uploads); None on
-            # cpu/smoke runs where the ring is not exercised.
-            "learner_steps_per_sec_device_replay": (
-                round(dev_steps_per_sec, 2)
-                if dev_steps_per_sec is not None
-                else None
-            ),
-            "fused_group_size": fused_k,
-            "learner_batch": b,
-            "first_chunk_compile_seconds": round(compile_s, 1),
-            "device_kind": device_kind,
-            "flops": flops_extra,
-            "overlapped": overlapped,
-        },
-    }
+# Most recent partial snapshot emitted by run_bench (child process
+# only): the crash path must finish with the best real measurement,
+# not bury it under a zero-value error line.
+_last_partial: "dict | None" = None
 
 
 def error_result(extra: dict) -> dict:
@@ -809,7 +867,17 @@ def child_main() -> None:
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        out = error_result({"error": f"{type(exc).__name__}: {exc}"})
+        if _last_partial is not None:
+            # Sections that completed before the crash are a real
+            # measurement; re-emit the newest snapshot (still tagged
+            # extra.partial) with the crash recorded beside it, so the
+            # LAST line the supervisor parses is the best one.
+            out = _last_partial
+            out["extra"]["error_after_partial"] = (
+                f"{type(exc).__name__}: {exc}"
+            )
+        else:
+            out = error_result({"error": f"{type(exc).__name__}: {exc}"})
     emit(out)
 
 
@@ -867,7 +935,7 @@ def run_child(platform: "str | None", timeout_s: float) -> "dict | None":
             if (
                 stop_on_result
                 and buf.endswith(b"\n")
-                and parse_last_json_line(buf) is not None
+                and is_final_result(parse_last_json_line(buf))
             ):
                 return "result"
 
@@ -933,6 +1001,16 @@ def parse_last_json_line(buf: bytes) -> "dict | None":
     return None
 
 
+def is_final_result(parsed: "dict | None") -> bool:
+    """True when `parsed` is a COMPLETE result line.
+
+    The child emits a cumulative snapshot after each section, tagged
+    `extra.partial`, so a mid-run wedge still leaves every completed
+    section's numbers on the pipe; the supervisor must keep draining
+    past those and only early-stop on the final, untagged line."""
+    return parsed is not None and not parsed.get("extra", {}).get("partial")
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "1":
         child_main()
@@ -970,6 +1048,24 @@ def main() -> None:
                 "accelerator attempt hung/crashed after passing the init "
                 f"probe (killed at {budget:.0f}s budget)"
             )
+        elif (
+            os.environ.get("BENCH_NO_CPU_FALLBACK") == "1"
+            and out.get("extra", {}).get("backend") == "cpu"
+        ):
+            # The plugin passed the probe but the measurement child
+            # silently fell back to CPU (plugin init failed inside the
+            # child). In sweep mode that row must NOT land: it would
+            # record a cpu-backend measurement under a TPU section
+            # label AND burn the minutes sweep mode exists to avoid.
+            log(
+                "bench: child completed on cpu backend under "
+                "BENCH_NO_CPU_FALLBACK; discarding the measurement"
+            )
+            out = None
+            probe_error = (
+                "accelerator probe passed but the measurement child "
+                "resolved to the cpu backend"
+            )
         if out is None:
             log(f"bench: {probe_error}")
 
@@ -994,6 +1090,13 @@ def main() -> None:
 
     if probe_error:
         out.setdefault("extra", {})["probe_error"] = probe_error
+    if out.get("extra", {}).get("partial"):
+        # Killed/crashed mid-run after >=1 completed section: the kept
+        # snapshot is real, but the record says which sections ran.
+        log(
+            "bench: keeping PARTIAL result (completed through "
+            f"'{out['extra']['partial']}' section)"
+        )
     if out.get("extra", {}).get("backend") != "tpu":
         # A CPU-fallback number is not the TPU story; point at the
         # preserved on-hardware measurement for comparison.

@@ -43,6 +43,17 @@ def main() -> None:
     args = ap.parse_args()
     enforce_platform(args.device or "auto")
 
+    import jax
+
+    from alphatriangle_tpu.utils.helpers import (  # noqa: E402
+        enable_persistent_compilation_cache,
+    )
+
+    # Re-call with the resolved backend: the unpinned-auto case defers
+    # (utils/helpers.py), and the ladder compiles the flagship search
+    # programs repeatedly across rungs.
+    enable_persistent_compilation_cache(backend=jax.default_backend())
+
     import numpy as np
 
     from alphatriangle_tpu.arena import greedy_mcts_policy, play

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-5 on-chip measurement sweep (run only in a healthy-chip window;
+# probe first: timeout 60 python -c "import jax; print(jax.devices())").
+# Each section appends its JSON line to benchmarks/tpu_r5_results.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+out=benchmarks/tpu_r5_results.jsonl
+run() {
+  label="$1"; shift
+  # Resumable: a section already recorded (an earlier run before a
+  # mid-sweep wedge) is skipped, so the watcher can relaunch the whole
+  # script until every section lands.
+  if grep -q "\"label\": \"$label\"" "$out" 2>/dev/null; then
+    echo "=== $label === already recorded; skipping" >&2
+    return 0
+  fi
+  echo "=== $label ===" >&2
+  # BENCH_NO_CPU_FALLBACK: a wedge mid-attempt aborts fast with an
+  # error line instead of burning minutes on a CPU run this sweep
+  # would refuse to record anyway. Outer timeout is a backstop above
+  # the supervisor's own probe (300s) + attempt (900s) budgets.
+  line=$(env "$@" BENCH_INIT_TIMEOUT=90 BENCH_INIT_BUDGET=300 \
+    BENCH_NO_CPU_FALLBACK=1 timeout 1500 python bench.py)
+  if [ -z "$line" ]; then
+    echo "$label: bench produced no JSON (killed?); aborting sweep" >&2
+    exit 1
+  fi
+  # A section that fell back to CPU means the chip wedged mid-sweep:
+  # every further section would burn its probe budget and record
+  # CPU-scale numbers under a TPU label. Abort WITHOUT recording the
+  # line — the resume-skip would otherwise pin the mislabeled row
+  # forever — and rerun in a new window.
+  if ! printf '%s' "$line" | grep -q '"backend": "tpu"'; then
+    echo "$label: backend != tpu (chip wedged?); aborting sweep" >&2
+    exit 1
+  fi
+  # Same rule for a wedge-truncated PARTIAL snapshot (some sections
+  # missing): recording it would pin the incomplete row against the
+  # resume-skip forever; abort and re-measure in the next window.
+  if printf '%s' "$line" | grep -q '"partial":'; then
+    echo "$label: partial result (wedge mid-section?); aborting sweep" >&2
+    exit 1
+  fi
+  echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
+}
+# 1. Flagship, new default recipe (gumbel+PCR) + pipelined overlap + MFU.
+run flagship_gumbel_pcr BENCH_SECONDS=75
+# 2. Reference-parity PUCT for comparison.
+run flagship_puct BENCH_RECIPE=puct BENCH_SECONDS=60
+# 3. Gather lowering A/B (short windows).
+run gather_pallas BENCH_GATHER=pallas BENCH_SECONDS=45
+run gather_take BENCH_GATHER=take BENCH_SECONDS=45
+# 4. BASELINE presets 2-5.
+run preset2 BENCH_CONFIG=2 BENCH_SECONDS=60
+run preset3 BENCH_CONFIG=3 BENCH_SECONDS=60
+run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
+run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
+# 5. Multi-stream overlap.
+run flagship_workers2 BENCH_WORKERS=2 BENCH_SECONDS=60
+# 6. Lane-count A/B: lanes are the direct lever on self-play MFU
+# (B=512 measured 1.4%); B=1024/2048 double/quadruple every wave's
+# MXU batch at the same program shape.
+run flagship_b1024 BENCH_BATCH=1024 BENCH_SECONDS=60
+run flagship_b2048 BENCH_BATCH=2048 BENCH_SECONDS=60
+# 7. Wave-size A/B (MXU batch per eval = lanes x wave). PUCT recipe:
+# under gumbel_pcr the fast searches clamp the wave anyway and a
+# 64-wave 64-sim gumbel collapses sequential halving to one phase —
+# the A/B would change the algorithm, not just the batching.
+run wave16 BENCH_WAVE=16 BENCH_RECIPE=puct BENCH_SECONDS=45
+run wave64 BENCH_WAVE=64 BENCH_RECIPE=puct BENCH_SECONDS=45
+# 8. XLA trace of the flagship self-play (not a headline number — the
+# MFU diagnosis input for the next optimization round).
+run flagship_profile BENCH_PROFILE=1 BENCH_SECONDS=30
+echo "sweep complete" >&2

@@ -83,3 +83,33 @@ class TestSupervisorErrorPath:
         assert out["value"] == 0.0
         assert out["extra"]["backend"] == "none"
         assert "probe" in out["extra"]["error"]
+
+
+class TestPartialSnapshots:
+    def test_is_final_result(self):
+        assert not bench.is_final_result(None)
+        assert bench.is_final_result({"metric": "x", "extra": {}})
+        assert bench.is_final_result({"metric": "x"})
+        assert not bench.is_final_result(
+            {"metric": "x", "extra": {"partial": "self_play"}}
+        )
+
+    def test_last_partial_wins_over_stream(self):
+        # The supervisor keeps the NEWEST snapshot when the child is
+        # killed mid-run: later sections' lines supersede earlier ones.
+        lines = (
+            b'{"metric": "m", "value": 1, "extra": {"partial": "self_play"}}\n'
+            b'{"metric": "m", "value": 1, "extra": {"partial": "learner"}}\n'
+        )
+        parsed = bench.parse_last_json_line(lines)
+        assert parsed["extra"]["partial"] == "learner"
+        assert not bench.is_final_result(parsed)
+
+    def test_final_line_supersedes_partials(self):
+        lines = (
+            b'{"metric": "m", "value": 1, "extra": {"partial": "self_play"}}\n'
+            b'{"metric": "m", "value": 2, "extra": {}}\n'
+        )
+        parsed = bench.parse_last_json_line(lines)
+        assert bench.is_final_result(parsed)
+        assert parsed["value"] == 2

@@ -162,3 +162,64 @@ class TestFlops:
         assert peak_bf16_tflops("cpu") is None
         assert mfu(394e12 / 2, "TPU v5 lite") == 0.5
         assert mfu(1.0, "unknown-chip") is None
+
+
+class TestCompileCacheGate:
+    """The persistent-cache gate must never enable for a CPU backend
+    (XLA:CPU AOT reloads log SIGILL-risk feature mismatches) — including
+    the auto-on-a-cpu-only-host path where no platform is pinned."""
+
+    def _calls(self, monkeypatch, env_platforms=None):
+        import types
+
+        from alphatriangle_tpu.utils import helpers
+
+        recorded = []
+        # Stub the module's jax view: jax.config is read-only property
+        # soup, and the conftest pins jax_platforms=cpu process-wide —
+        # a stub lets each case control exactly what the gate sees.
+        config = types.SimpleNamespace(
+            jax_platforms="",
+            update=lambda k, v: recorded.append((k, v)),
+        )
+        monkeypatch.setattr(
+            helpers, "jax", types.SimpleNamespace(config=config)
+        )
+        if env_platforms is None:
+            monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        else:
+            monkeypatch.setenv("JAX_PLATFORMS", env_platforms)
+        return helpers, recorded
+
+    def test_resolved_cpu_backend_skips(self, monkeypatch):
+        helpers, calls = self._calls(monkeypatch)
+        helpers.enable_persistent_compilation_cache(backend="cpu")
+        assert calls == []
+
+    def test_resolved_tpu_backend_enables(self, monkeypatch):
+        helpers, calls = self._calls(monkeypatch)
+        helpers.enable_persistent_compilation_cache(backend="tpu")
+        assert any(k == "jax_compilation_cache_dir" for k, _ in calls)
+
+    def test_unpinned_auto_defers(self, monkeypatch):
+        # No pinned platform and no resolved backend: must NOT enable —
+        # the run may resolve to XLA:CPU (the SIGILL-risk path).
+        helpers, calls = self._calls(monkeypatch)
+        helpers.enable_persistent_compilation_cache()
+        assert calls == []
+
+    def test_pinned_cpu_skips(self, monkeypatch):
+        helpers, calls = self._calls(monkeypatch, env_platforms="cpu")
+        helpers.enable_persistent_compilation_cache()
+        assert calls == []
+
+    def test_pinned_tpu_enables(self, monkeypatch):
+        helpers, calls = self._calls(monkeypatch, env_platforms="tpu")
+        helpers.enable_persistent_compilation_cache()
+        assert any(k == "jax_compilation_cache_dir" for k, _ in calls)
+
+    def test_opt_out_env_wins(self, monkeypatch):
+        helpers, calls = self._calls(monkeypatch)
+        monkeypatch.setenv("ALPHATRIANGLE_NO_COMPILE_CACHE", "1")
+        helpers.enable_persistent_compilation_cache(backend="tpu")
+        assert calls == []
