@@ -107,15 +107,19 @@ class TrainConfig(BaseModel):
     FUSED_LEARNER_STEPS: int = Field(default=1, ge=1)
     BUFFER_CAPACITY: int = Field(default=250_000, ge=1)
     MIN_BUFFER_SIZE_TO_TRAIN: int = Field(default=25_000, ge=1)
-    # Device-resident replay ring (rl/device_buffer.py): experiences
-    # stream from the rollout program into an on-device ring buffer and
-    # training batches are gathered on device from host-chosen indices,
-    # so the steady-state training loop moves only scalars, indices and
-    # metrics between host and device. "auto" enables it on a
-    # single-device, single-process accelerator mesh (where the
-    # host<->device link — PCIe, or a network tunnel in dev — is the
-    # measured learner bottleneck); "off" keeps the host SoA ring;
-    # "on" forces it (CPU backend included — used by tests).
+    # Device-resident replay ring: experiences stream from the rollout
+    # program into an on-device ring buffer and training batches are
+    # gathered on device from host-chosen indices, so the steady-state
+    # training loop moves only scalars, indices and metrics between
+    # host and device. "auto" enables it on single-process accelerator
+    # meshes (where the host<->device link — PCIe, or a network tunnel
+    # in dev — is the measured learner bottleneck): one chip gets the
+    # single ring (rl/device_buffer.py); a dp-only multi-device mesh
+    # gets the dp-SHARDED ring (rl/sharded_device_buffer.py) — each
+    # device ingests its own rollout lanes and gathers its own batch
+    # shard, so no experience bytes cross devices either. "off" keeps
+    # the host SoA ring; "on" forces the device ring (CPU backend
+    # included — used by tests).
     DEVICE_REPLAY: Literal["auto", "on", "off"] = Field(default="auto")
 
     # --- N-step returns ---

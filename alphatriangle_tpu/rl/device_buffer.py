@@ -41,10 +41,10 @@ float32, so a batch sampled from the device ring is bit-identical to
 the same rows sampled from the host ring.
 
 Single-device, single-process only (gated in `training/setup.py`):
-the ring lives on one chip. The multi-chip extension — shard the ring
-over the dp axis, each device ingesting its own streams' rollouts —
-is a sharding annotation away but unneeded at the flagship scale
-(reference trains on ONE device; SURVEY.md §2c).
+this ring lives on one chip. The multi-chip variant — ring sharded
+over the dp axis, each device ingesting its own lanes' rollouts via
+`shard_map` and gathering its own batch rows — is
+`rl/sharded_device_buffer.py`.
 
 CPU-backend caveat (DEVICE_REPLAY="on" there is a test/dev mode):
 XLA:CPU's *async dispatch* deadlocks when one thread blocks on an
@@ -75,6 +75,74 @@ logger = logging.getLogger(__name__)
 # Canonical field order for experience row blocks (the key names the
 # rollout program emits for its `mat`/`flush` outputs).
 _BLOCK_FIELDS = ("grid", "other", "policy", "ret", "pw")
+
+
+def ring_scatter(
+    storage: dict[str, jax.Array],
+    cursor: jax.Array,
+    blocks: tuple[dict[str, jax.Array], ...],
+    cap: int,
+):
+    """Flatten + validate + ring-scatter experience blocks (pure).
+
+    The single source of the ingest math for BOTH device rings: the
+    single-device buffer calls it whole-ring, the dp-sharded buffer
+    calls it per shard inside `shard_map` — the validation predicate
+    and keep/trash-slot rules must never diverge between them.
+
+    Each block holds arrays with arbitrary leading dims (the chunk
+    program's (T,B) matured and (T,B,n) flushed outputs) plus a boolean
+    `mask` over those leading dims. Rows are written in block order,
+    leading-dims-major — the same order the host path produces via
+    boolean indexing, so the paths fill identical slots with identical
+    rows. Returns (new_storage, new_cursor, rows_written)."""
+
+    def flat(block: dict[str, jax.Array], f: str) -> jax.Array:
+        lead = block["mask"].shape
+        v = block[f]
+        return v.reshape(-1, *v.shape[len(lead):])
+
+    rows = {
+        f: jnp.concatenate([flat(b, f) for b in blocks])
+        for f in _BLOCK_FIELDS
+    }
+    mask = jnp.concatenate([b["mask"].reshape(-1) for b in blocks])
+    # Validation absorbed from SelfPlayResult's validator + the host
+    # buffer's finite filter (rl/types.py:78-85, buffer.py:120-128).
+    valid = (
+        mask
+        & jnp.isfinite(rows["grid"]).all(axis=(1, 2, 3))
+        & jnp.isfinite(rows["other"]).all(axis=1)
+        & jnp.isfinite(rows["policy"]).all(axis=1)
+        & jnp.isfinite(rows["ret"])
+        & (jnp.abs(rows["policy"].sum(axis=1) - 1.0) < 1e-3)
+    )
+    offsets = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    count = valid.sum(dtype=jnp.int32)
+    # A single ingest larger than the ring keeps only the newest `cap`
+    # rows — the older ones would be overwritten by the wrap anyway,
+    # and dropping them guarantees distinct scatter slots, making
+    # last-write-wins deterministic (`.at[pos].set` with duplicate
+    # indices has an unspecified winner). The cursor still advances by
+    # the full count, matching the host ring.
+    keep = valid & (offsets >= count - cap)
+    pos = jnp.where(keep, (cursor + offsets) % cap, cap)
+    new_storage = {
+        "grid": storage["grid"].at[pos].set(rows["grid"].astype(jnp.int8)),
+        "other_features": storage["other_features"]
+        .at[pos]
+        .set(rows["other"].astype(jnp.float32)),
+        "policy_target": storage["policy_target"]
+        .at[pos]
+        .set(rows["policy"].astype(jnp.float32)),
+        "value_target": storage["value_target"]
+        .at[pos]
+        .set(rows["ret"].astype(jnp.float32)),
+        "policy_weight": storage["policy_weight"]
+        .at[pos]
+        .set(rows["pw"].astype(jnp.float32)),
+    }
+    return new_storage, (cursor + count) % cap, count
 
 
 class DeviceReplayBuffer(ExperienceBuffer):
@@ -119,61 +187,10 @@ class DeviceReplayBuffer(ExperienceBuffer):
     ):
         """Flatten + validate + ring-scatter experience blocks.
 
-        Each block holds arrays with arbitrary leading dims (the chunk
-        program's (T,B) matured and (T,B,n) flushed outputs) plus a
-        boolean `mask` over those leading dims. Rows are written in
-        block order, leading-dims-major — the same order the host path
-        produces via boolean indexing, so the two paths fill identical
-        slots with identical rows.
+        The math lives in the module-level `ring_scatter` (shared with
+        the dp-sharded ring's per-shard ingest).
         """
-        cap = self.capacity
-
-        def flat(block: dict[str, jax.Array], f: str) -> jax.Array:
-            lead = block["mask"].shape
-            v = block[f]
-            return v.reshape(-1, *v.shape[len(lead) :])
-
-        rows = {
-            f: jnp.concatenate([flat(b, f) for b in blocks])
-            for f in _BLOCK_FIELDS
-        }
-        mask = jnp.concatenate([b["mask"].reshape(-1) for b in blocks])
-        # Validation absorbed from SelfPlayResult's validator + the host
-        # buffer's finite filter (rl/types.py:78-85, buffer.py:120-128).
-        valid = (
-            mask
-            & jnp.isfinite(rows["grid"]).all(axis=(1, 2, 3))
-            & jnp.isfinite(rows["other"]).all(axis=1)
-            & jnp.isfinite(rows["policy"]).all(axis=1)
-            & jnp.isfinite(rows["ret"])
-            & (jnp.abs(rows["policy"].sum(axis=1) - 1.0) < 1e-3)
-        )
-        offsets = jnp.cumsum(valid.astype(jnp.int32)) - 1
-        count = valid.sum(dtype=jnp.int32)
-        # A single ingest larger than the ring keeps only the newest
-        # `cap` rows — the older ones would be overwritten by the wrap
-        # anyway, and dropping them guarantees distinct scatter slots,
-        # making last-write-wins deterministic (`.at[pos].set` with
-        # duplicate indices has an unspecified winner). The cursor still
-        # advances by the full count, matching the host ring.
-        keep = valid & (offsets >= count - cap)
-        pos = jnp.where(keep, (cursor + offsets) % cap, cap)
-        new_storage = {
-            "grid": storage["grid"].at[pos].set(rows["grid"].astype(jnp.int8)),
-            "other_features": storage["other_features"]
-            .at[pos]
-            .set(rows["other"].astype(jnp.float32)),
-            "policy_target": storage["policy_target"]
-            .at[pos]
-            .set(rows["policy"].astype(jnp.float32)),
-            "value_target": storage["value_target"]
-            .at[pos]
-            .set(rows["ret"].astype(jnp.float32)),
-            "policy_weight": storage["policy_weight"]
-            .at[pos]
-            .set(rows["pw"].astype(jnp.float32)),
-        }
-        return new_storage, (cursor + count) % cap, count
+        return ring_scatter(storage, cursor, blocks, self.capacity)
 
     def _ingest_blocks(
         self, blocks: "tuple[dict[str, Any], ...]"

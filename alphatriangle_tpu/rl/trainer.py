@@ -227,6 +227,12 @@ class Trainer:
         self._from_fn = jax.jit(
             self._train_steps_from_impl, donate_argnums=(0,)
         )
+        # dp-sharded ring variant (rl/sharded_device_buffer.py): built
+        # lazily on first use, cached per shard geometry — the program
+        # closes over (stride, dp_axis), and a geometry change with a
+        # stale program would gather silently-wrong rows (JAX clamps
+        # out-of-range gather indices rather than erroring).
+        self._from_sharded_fns: dict[tuple, Any] = {}
         # Keep state resident on the mesh (replicated, or TP-sharded
         # over the mdl axis when it is wider than 1).
         self.state = jax.device_put(self.state, state_shard)
@@ -357,6 +363,46 @@ class Trainer:
             unroll=True if jax.default_backend() == "cpu" else 1,
         )
         return state, metrics_k, td_k
+
+    def _get_from_sharded_fn(self, buffer):
+        """Jitted fused-steps program for the dp-SHARDED replay ring:
+        each device gathers its B/dp batch rows from its LOCAL ring
+        shard (shard_map, no collectives), then runs the dp-sharded
+        fused train step. Index upload stays K*B int32 — the sharded
+        ring keeps the index-only-upload property per device."""
+        key = (buffer.stride, buffer.dp_axis)
+        if key not in self._from_sharded_fns:
+            stride = buffer.stride
+            dp_axis = buffer.dp_axis
+
+            def gather_local(storage_local, idx_local):
+                base = jax.lax.axis_index(dp_axis) * stride
+                local = idx_local - base  # global encoding -> local slot
+                return {k: v[local] for k, v in storage_local.items()}
+
+            gather = jax.shard_map(
+                gather_local,
+                mesh=self.mesh,
+                in_specs=(P(dp_axis), P(None, dp_axis)),
+                out_specs=P(None, dp_axis),
+            )
+
+            def impl(state, storage, idx, weights):
+                g = gather(storage, idx)
+                stacked: DenseBatch = {
+                    "grid": g["grid"].astype(jnp.float32),
+                    "other_features": g["other_features"],
+                    "policy_target": g["policy_target"],
+                    "value_target": g["value_target"],
+                    "policy_weight": g["policy_weight"],
+                    "weights": jax.lax.with_sharding_constraint(
+                        weights, self._stacked_shard
+                    ),
+                }
+                return self._train_steps_impl(state, stacked)
+
+            self._from_sharded_fns[key] = jax.jit(impl, donate_argnums=(0,))
+        return self._from_sharded_fns[key]
 
     def _train_steps_from_impl(self, state: TrainState, storage, idx, weights):
         """K fused steps whose batches are gathered from the device
@@ -508,10 +554,12 @@ class Trainer:
     ) -> dict | None:
         """Pipelined dispatch of a device-gathered fused group.
 
-        `samples` are `DeviceReplayBuffer.sample` outputs ({"indices",
-        "weights"}). Single-process only — the ring lives on one chip
-        (gated in training/setup.py). Same handle/fetch contract as
-        `train_steps_begin`/`train_steps_finish`.
+        `samples` are `DeviceReplayBuffer.sample` /
+        `ShardedDeviceReplayBuffer.sample` outputs ({"indices",
+        "weights"}); the sharded ring routes through a per-device
+        local gather. Single-process only (gated in training/setup.py).
+        Same handle/fetch contract as `train_steps_begin`/
+        `train_steps_finish`.
         """
         if not samples:
             return None
@@ -521,7 +569,12 @@ class Trainer:
         weights = np.stack(
             [np.asarray(s["weights"], dtype=np.float32) for s in samples]
         )
-        self.state, metrics_k, td_k = self._from_fn(
+        from_fn = (
+            self._get_from_sharded_fn(buffer)
+            if getattr(buffer, "is_sharded", False)
+            else self._from_fn
+        )
+        self.state, metrics_k, td_k = from_fn(
             self.state, buffer.storage, idx, weights
         )
         handle = {

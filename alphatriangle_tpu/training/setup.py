@@ -87,32 +87,54 @@ def _make_buffer(
 ) -> ExperienceBuffer:
     """Pick the replay-ring home per `TrainConfig.DEVICE_REPLAY`.
 
-    The device ring (rl/device_buffer.py) requires a single-process,
-    single-device mesh — it lives on one chip. "auto" additionally
-    requires an accelerator backend: on the CPU backend host NumPy and
-    "device" memory are the same RAM, so the scatter program would add
-    overhead for nothing ("on" still forces it there — tests do).
+    Three tiers:
+    - single-device, single-process mesh -> `DeviceReplayBuffer`
+      (rl/device_buffer.py): the ring lives on the one chip;
+    - dp-ONLY multi-device mesh (mdl == sp == 1, single process, with
+      capacity and batch dividing dp) -> `ShardedDeviceReplayBuffer`
+      (rl/sharded_device_buffer.py): the ring shards over dp and
+      composes with dp-sharded rollouts into a fully device-local
+      experience path;
+    - anything else -> host buffer.
+
+    "auto" additionally requires an accelerator backend: on the CPU
+    backend host NumPy and "device" memory are the same RAM, so the
+    scatter program would add overhead for nothing ("on" still forces
+    it there — tests do).
     """
     import jax
 
+    grid_shape = (
+        model_config.GRID_INPUT_CHANNELS,
+        env_config.ROWS,
+        env_config.COLS,
+    )
     mode = train_config.DEVICE_REPLAY
     single = jax.process_count() == 1 and mesh.devices.size == 1
+    # First axis is data-parallel by convention (MeshConfig.build_mesh).
+    dp = mesh.shape[mesh.axis_names[0]]
+    sharded_ok = (
+        jax.process_count() == 1
+        and mesh.devices.size > 1
+        and mesh.devices.size == dp  # dp-only: no mdl/sp replication
+        and train_config.BUFFER_CAPACITY % dp == 0
+        and train_config.BATCH_SIZE % dp == 0
+        # The ingest shard_map splits payload lanes dp-ways, so the
+        # rollout engine must actually be lane-sharded the same way —
+        # a single-device engine's payload would crash the scatter.
+        and train_config.SELF_PLAY_BATCH_SIZE % dp == 0
+    )
     want = mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
-    if mode == "on" and not single:
+    if mode == "on" and not (single or sharded_ok):
         # An explicit force that can't be honored must not silently
         # substitute the other code path.
         raise ValueError(
-            "DEVICE_REPLAY='on' needs a single-device, single-process "
-            f"mesh (got {mesh.devices.size} devices / "
-            f"{jax.process_count()} processes); use DEVICE_REPLAY='auto' "
-            "to fall back to the host buffer on multi-device meshes."
-        )
-    if want and not single:
-        logger.info(
-            "DEVICE_REPLAY=auto: multi-device mesh (%d devices / %d "
-            "processes) -> host buffer.",
-            mesh.devices.size,
-            jax.process_count(),
+            "DEVICE_REPLAY='on' needs a single-device mesh or a "
+            "single-process dp-only mesh with BUFFER_CAPACITY, "
+            "BATCH_SIZE and SELF_PLAY_BATCH_SIZE divisible by dp "
+            f"(got {dict(mesh.shape)}, {jax.process_count()} "
+            "processes); use DEVICE_REPLAY='auto' to fall back to "
+            "the host buffer."
         )
     if want and single:
         from ..rl.device_buffer import DeviceReplayBuffer
@@ -124,13 +146,32 @@ def _make_buffer(
         )
         return DeviceReplayBuffer(
             train_config,
-            grid_shape=(
-                model_config.GRID_INPUT_CHANNELS,
-                env_config.ROWS,
-                env_config.COLS,
-            ),
+            grid_shape=grid_shape,
             other_dim=extractor.other_dim,
             action_dim=env_config.action_dim,
+        )
+    if want and sharded_ok:
+        from ..rl.sharded_device_buffer import ShardedDeviceReplayBuffer
+
+        logger.info(
+            "dp-sharded device replay ring: capacity %d over %d shards.",
+            train_config.BUFFER_CAPACITY,
+            dp,
+        )
+        return ShardedDeviceReplayBuffer(
+            train_config,
+            grid_shape=grid_shape,
+            other_dim=extractor.other_dim,
+            action_dim=env_config.action_dim,
+            mesh=mesh,
+            dp_axis=mesh.axis_names[0],
+        )
+    if want:
+        logger.info(
+            "DEVICE_REPLAY=%s: mesh %s not eligible for a device ring "
+            "-> host buffer.",
+            mode,
+            dict(mesh.shape),
         )
     return ExperienceBuffer(train_config, action_dim=env_config.action_dim)
 
