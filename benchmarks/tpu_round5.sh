@@ -43,18 +43,22 @@ run() {
   fi
   echo "{\"label\": \"$label\", \"result\": $line}" >> "$out"
 }
+# Section order = round-5 VERDICT priority: the flagship device-replay
+# learner + overlapped numbers first (item 1), then the unmeasured
+# BASELINE presets (item 4), then the A/Bs (item 7) — healthy windows
+# last ~20-30 min, so later sections may wait for another window.
 # 1. Flagship, new default recipe (gumbel+PCR) + pipelined overlap + MFU.
 run flagship_gumbel_pcr BENCH_SECONDS=75
 # 2. Reference-parity PUCT for comparison.
 run flagship_puct BENCH_RECIPE=puct BENCH_SECONDS=60
-# 3. Gather lowering A/B (short windows).
+# 3. BASELINE presets 2-5 (2 and 4 are the VERDICT's named gaps).
+run preset2 BENCH_CONFIG=2 BENCH_SECONDS=60
+run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
+run preset3 BENCH_CONFIG=3 BENCH_SECONDS=60
+run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
+# 4. Gather lowering A/B (short windows).
 run gather_pallas BENCH_GATHER=pallas BENCH_SECONDS=45
 run gather_take BENCH_GATHER=take BENCH_SECONDS=45
-# 4. BASELINE presets 2-5.
-run preset2 BENCH_CONFIG=2 BENCH_SECONDS=60
-run preset3 BENCH_CONFIG=3 BENCH_SECONDS=60
-run preset4 BENCH_CONFIG=4 BENCH_SECONDS=60
-run preset5 BENCH_CONFIG=5 BENCH_SECONDS=60
 # 5. Multi-stream overlap.
 run flagship_workers2 BENCH_WORKERS=2 BENCH_SECONDS=60
 # 6. Lane-count A/B: lanes are the direct lever on self-play MFU
