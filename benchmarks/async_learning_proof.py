@@ -53,7 +53,26 @@ from alphatriangle_tpu.training import (  # noqa: E402
 )
 
 
-def main() -> int:
+def run_proof(
+    topology: str,
+    out_name: str,
+    run_name: str,
+    default_root: str,
+    train_overrides: "dict | None" = None,
+    mesh_config=None,
+    post_setup=None,
+    extra_payload=None,
+) -> dict:
+    """Shared proof scaffolding: one world, one recipe, ONE fixed
+    evaluator for every topology variant — the 'apples-to-apples'
+    claim across learning_curve.py, this file and
+    sharded_learning_proof.py holds exactly because this is the single
+    copy of the configs and the before/after protocol.
+
+    `train_overrides` parameterizes the topology under test;
+    `post_setup(c)` asserts the intended components were built;
+    `extra_payload(c, loop)` adds topology-specific result fields.
+    """
     steps = int(os.environ.get("PROOF_STEPS", "1500"))
     eval_games = int(os.environ.get("PROOF_EVAL_GAMES", "256"))
 
@@ -69,7 +88,7 @@ def main() -> int:
         gumbel_m=8,
         fast_simulations=4,
     )
-    train_cfg = TrainConfig(
+    train_kw = dict(
         SELF_PLAY_BATCH_SIZE=32,
         ROLLOUT_CHUNK_MOVES=4,
         BATCH_SIZE=64,
@@ -80,7 +99,7 @@ def main() -> int:
         LEARNING_RATE=1e-3,
         N_STEP_RETURNS=3,
         TEMPERATURE_ANNEAL_MOVES=8,
-        # The overlapped topology under test.
+        # The overlapped topology under test (variants override).
         ASYNC_ROLLOUTS=True,
         PIPELINE_LEARNER=True,
         FUSED_LEARNER_STEPS=4,
@@ -88,23 +107,29 @@ def main() -> int:
         REPLAY_RATIO=1.0,
         AUTO_RESUME_LATEST=False,
         CHECKPOINT_SAVE_FREQ_STEPS=100_000,  # not under test
-        RUN_NAME="async_proof",
+        RUN_NAME=run_name,
     )
-    root = Path(os.environ.get("PROOF_ROOT", "/tmp/async_proof"))
+    train_kw.update(train_overrides or {})
+    train_cfg = TrainConfig(**train_kw)
+    root = Path(os.environ.get("PROOF_ROOT", default_root))
     c = setup_training_components(
         train_config=train_cfg,
         env_config=env_cfg,
         model_config=model_cfg,
         mcts_config=mcts_cfg,
+        mesh_config=mesh_config,
         persistence_config=PersistenceConfig(
-            ROOT_DATA_DIR=str(root), RUN_NAME="async_proof"
+            ROOT_DATA_DIR=str(root), RUN_NAME=run_name
         ),
         use_tensorboard=False,
     )
+    if post_setup is not None:
+        post_setup(c)
 
     # Fixed evaluator: greedy PUCT-16, 60-move games averaged over
-    # seeds 11 and 22 — EXACTLY learning_curve.py's run_eval, so this
-    # row is apples-to-apples with the round-3 curves in BASELINE.md.
+    # seeds 11 and 22 — EXACTLY learning_curve.py's run_eval, so every
+    # proof row is apples-to-apples with the round-3 curves in
+    # BASELINE.md.
     eval_mcts_cfg = AlphaTriangleMCTSConfig(
         max_simulations=16, max_depth=6, mcts_batch_size=8,
         dirichlet_epsilon=0.0,
@@ -139,8 +164,7 @@ def main() -> int:
     print(f"trained greedy eval: {after:.2f}", flush=True)
 
     payload = {
-        "topology": "overlapped: pipelined learner + auto-chunk + "
-        "2 streams + fused groups + Gumbel+PCR",
+        "topology": topology,
         "steps": loop.global_step,
         "train_seconds": round(train_seconds, 1),
         "steps_per_sec": round(loop.global_step / train_seconds, 2),
@@ -158,11 +182,24 @@ def main() -> int:
         "trained_eval": round(after, 2),
         "improvement_pct": round(100 * (after - before) / max(before, 1e-9), 1),
     }
-    out = REPO / "benchmarks" / "async_learning_results.json"
+    if extra_payload is not None:
+        payload.update(extra_payload(c, loop))
+    out = REPO / "benchmarks" / out_name
     out.write_text(json.dumps(payload, indent=2))
     print(json.dumps(payload))
     c.stats.close()
     c.checkpoints.close()
+    return payload
+
+
+def main() -> int:
+    run_proof(
+        topology="overlapped: pipelined learner + auto-chunk + "
+        "2 streams + fused groups + Gumbel+PCR",
+        out_name="async_learning_results.json",
+        run_name="async_proof",
+        default_root="/tmp/async_proof",
+    )
     return 0
 
 

@@ -1,0 +1,85 @@
+"""Learning proof for the MULTI-CHIP data path: dp-sharded rollout
+lanes + dp-sharded device replay ring + dp-sharded learner, overlapped
+— the fully device-local experience path doesn't just run, it learns.
+
+Same protocol as benchmarks/async_learning_proof.py (whose `run_proof`
+scaffolding this parameterizes: same 4x6/2-slot world, same Gumbel+PCR
+recipe, same fixed greedy-PUCT evaluator, before/after on the same
+net), but through a virtual 8-device CPU mesh with `DEVICE_REPLAY=on`:
+rollouts shard 32 lanes 8 ways, every chunk's experiences
+shard_map-scatter into per-device ring shards, batches are
+stratified-sampled per shard and gathered device-locally. A matching
+improvement over the untrained baseline proves the stratified
+per-shard PER + sharded ingest/gather semantics train correctly end
+to end.
+
+Measured 2026-07-31 (single-core host, so the virtual mesh adds
+overhead rather than speed — the point is semantics, not throughput):
+21.69 -> 23.08 greedy eval (+6.4%) in 1200 steps at replay ratio 0.44;
+the single-device reference reached 24.00 (+10.7%) at 4000 steps.
+
+Usage:  python benchmarks/sharded_learning_proof.py
+Env:    PROOF_STEPS=N (default 1500), PROOF_EVAL_GAMES=N (default 256)
+Writes benchmarks/sharded_learning_results.json.
+"""
+
+import os
+import sys
+
+# 8 virtual devices BEFORE any jax import (conftest pattern).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# XLA:CPU async dispatch deadlocks under the device-replay thread
+# topology (rl/device_buffer.py module docstring); latched at client
+# creation, so set before any backend touch.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+# Shares run_proof (and through it the world/recipe/evaluator) with the
+# single-device overlapped proof; also re-asserts the CPU platform +
+# compile cache at its own import time.
+from async_learning_proof import run_proof  # noqa: E402
+
+from alphatriangle_tpu.config import MeshConfig  # noqa: E402
+from alphatriangle_tpu.rl.sharded_device_buffer import (  # noqa: E402
+    ShardedDeviceReplayBuffer,
+)
+
+DP = 8
+
+
+def main() -> int:
+    def post_setup(c):
+        assert isinstance(c.buffer, ShardedDeviceReplayBuffer), type(
+            c.buffer
+        )
+        assert c.self_play.mesh is not None
+
+    run_proof(
+        topology="dp-sharded (8 virtual devices): sharded rollout "
+        "lanes + sharded device replay ring + sharded learner, "
+        "overlapped + pipelined + fused + Gumbel+PCR",
+        out_name="sharded_learning_results.json",
+        run_name="sharded_proof",
+        default_root="/tmp/sharded_proof",
+        train_overrides={"DEVICE_REPLAY": "on"},
+        mesh_config=MeshConfig(DP_SIZE=DP),
+        post_setup=post_setup,
+        extra_payload=lambda c, loop: {
+            "ring_shard_sizes": [int(s) for s in c.buffer._sizes],
+            "single_device_reference": "async_learning_results.json: "
+            "21.69 -> 24.00 (+10.7%) in 4000 steps",
+        },
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,10 @@
-"""Render benchmarks/tpu_r4_results.jsonl as a BASELINE.md-ready table.
+"""Render a tpu_r{N}_results.jsonl sweep as a BASELINE.md-ready table.
 
-`benchmarks/tpu_round4.sh` appends one labeled bench JSON per sweep
+`benchmarks/tpu_round{N}.sh` appends one labeled bench JSON per sweep
 section; this prints a markdown table (games/h, leaf-evals/s, learner
 steps/s, MFU, overlapped combined rates) plus the gather-lowering A/B
 verdict, so the measured numbers drop straight into BASELINE.md.
+Default input: the newest tpu_r*_results*.jsonl next to this script.
 """
 
 import json
@@ -12,14 +13,26 @@ from pathlib import Path
 
 
 def main() -> int:
-    path = Path(
-        sys.argv[1]
-        if len(sys.argv) > 1
-        else Path(__file__).parent / "tpu_r4_results.jsonl"
-    )
+    if len(sys.argv) > 1:
+        path = Path(sys.argv[1])
+    else:
+        candidates = sorted(
+            Path(__file__).parent.glob("tpu_r*_results*.jsonl"),
+            key=lambda p: p.stat().st_mtime,
+        )
+        path = (
+            candidates[-1]
+            if candidates
+            else Path(__file__).parent / "tpu_r5_results.jsonl"
+        )
     if not path.is_file():
         print(f"no results at {path}", file=sys.stderr)
         return 1
+    # Always say which sweep is being rendered: the mtime default can
+    # legitimately resolve to an older round's file (e.g. before the
+    # current round's first section lands), and a table with no
+    # provenance invites pasting stale numbers into BASELINE.md.
+    print(f"reading {path}", file=sys.stderr)
     rows = []
     for i, line in enumerate(path.read_text().splitlines(), 1):
         if not line.strip():
