@@ -7,6 +7,13 @@ cd "$(dirname "$0")/.."
 out=benchmarks/tpu_r5_results.jsonl
 run() {
   label="$1"; shift
+  # BENCH_SECTIONS="a b c": run only the named sections (the
+  # orchestrator uses this to land the highest-priority numbers before
+  # handing the chip to the hours-long training run).
+  if [ -n "${BENCH_SECTIONS:-}" ] && \
+     ! printf ' %s ' "$BENCH_SECTIONS" | grep -q " $label "; then
+    return 0
+  fi
   # Resumable: a section already recorded (an earlier run before a
   # mid-sweep wedge) is skipped, so the watcher can relaunch the whole
   # script until every section lands.

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 healthy-window orchestrator (run by benchmarks/tpu_watch.sh).
+#
+# Priority order per the round-5 plan:
+#   1. The four headline sweep sections (flagship device-replay learner
+#      + overlapped numbers, presets 2 and 4) — minutes each, resumable.
+#   2. The on-hardware training run (hours; checkpoint-stall watchdog
+#      inside tpu_training_run.py survives mid-run wedges).
+#   3. The remaining sweep sections (A/Bs, presets 3/5, profile).
+#
+# Every phase is resumable/idempotent, so the watcher can relaunch this
+# script across as many healthy windows as it takes.
+set -u
+cd "$(dirname "$0")/.."
+
+KEY="flagship_gumbel_pcr flagship_puct preset2 preset4"
+BENCH_SECTIONS="$KEY" bash benchmarks/tpu_round5.sh || exit 1
+python benchmarks/tpu_training_run.py --steps 2000 --kill-at 600 \
+  --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || exit 1
+bash benchmarks/tpu_round5.sh
