@@ -17,4 +17,10 @@ KEY="flagship_gumbel_pcr flagship_puct preset2 preset4"
 BENCH_SECTIONS="$KEY" bash benchmarks/tpu_round5.sh || exit 1
 python benchmarks/tpu_training_run.py --steps 2000 --kill-at 600 \
   --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || exit 1
+# Close the subtree-reuse bet with the just-trained checkpoint
+# (docs/MCTS_DESIGN.md §a's revisit criterion; VERDICT r5 item 6).
+if [ ! -f benchmarks/reuse_bet_results.json ]; then
+  timeout 2400 python benchmarks/reuse_bet_closure.py \
+    --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || true
+fi
 bash benchmarks/tpu_round5.sh
