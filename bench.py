@@ -1099,12 +1099,66 @@ def main() -> None:
         )
     if out.get("extra", {}).get("backend") != "tpu":
         # A CPU-fallback number is not the TPU story; point at the
-        # preserved on-hardware measurement for comparison.
+        # newest preserved on-hardware measurement for comparison.
         out.setdefault("extra", {})["tpu_measurement_on_record"] = (
-            "benchmarks/bench_flagship_tpu_20260730.json: 211,771 "
-            "games/hour on one v5 lite chip (2026-07-30)"
+            latest_tpu_record()
         )
     emit(out)
+
+
+def latest_tpu_record(base_dir: "str | None" = None) -> str:
+    """Newest on-chip flagship measurement preserved in the repo —
+    cited on CPU-fallback lines so the round's official record always
+    carries the real TPU story even when the driver's window lands on
+    a wedged chip. Prefers the sweep jsonl artifacts (watcher-captured,
+    freshest first), falls back to the static round-3 artifact."""
+    import glob
+    import re
+
+    here = base_dir or os.path.dirname(os.path.abspath(__file__))
+
+    def round_key(path: str) -> tuple:
+        # Order by the round number IN the filename (durable across
+        # git checkouts, which flatten mtimes), mtime as tie-breaker.
+        m = re.search(r"tpu_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.getmtime(path))
+
+    for path in sorted(
+        glob.glob(os.path.join(here, "benchmarks", "tpu_r*_results*.jsonl")),
+        key=round_key,
+        reverse=True,
+    ):
+        try:
+            with open(path) as f:
+                rows = [
+                    json.loads(line)
+                    for line in f.read().splitlines()
+                    if line.strip()
+                ]
+        except (OSError, json.JSONDecodeError):
+            continue
+        for row in rows:
+            if not str(row.get("label", "")).startswith("flagship"):
+                continue
+            res = row.get("result", {})
+            value = res.get("value")
+            # Only a real on-chip number may be cited as the TPU
+            # record — the sweep can legitimately contain CPU-fallback
+            # or zero-value error rows from wedge windows.
+            if (
+                res.get("extra", {}).get("backend") != "tpu"
+                or not isinstance(value, (int, float))
+                or value <= 0
+            ):
+                continue
+            return (
+                f"{os.path.relpath(path, here)} [{row['label']}]: "
+                f"{value:,.0f} games/hour on one chip (backend tpu)"
+            )
+    return (
+        "benchmarks/bench_flagship_tpu_20260730.json: 211,771 "
+        "games/hour on one v5 lite chip (2026-07-30)"
+    )
 
 
 if __name__ == "__main__":

@@ -113,3 +113,61 @@ class TestPartialSnapshots:
         parsed = bench.parse_last_json_line(lines)
         assert bench.is_final_result(parsed)
         assert parsed["value"] == 2
+
+
+class TestLatestTpuRecord:
+    def test_prefers_newest_flagship_row(self, tmp_path):
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        old = bdir / "tpu_r4_results.jsonl"
+        old.write_text(
+            '{"label": "flagship_gumbel_pcr", "result": {"value": 1000.0,'
+            ' "extra": {"backend": "tpu"}}}\n'
+        )
+        new = bdir / "tpu_r5_results.jsonl"
+        new.write_text(
+            '{"label": "preset2", "result": {"value": 5.0, "extra": {}}}\n'
+            '{"label": "flagship_gumbel_pcr", "result": {"value": 2000.0,'
+            ' "extra": {"backend": "tpu"}}}\n'
+        )
+        import os as _os
+
+        _os.utime(old, (1, 1))
+        rec = bench.latest_tpu_record(base_dir=str(tmp_path))
+        assert "tpu_r5_results.jsonl" in rec and "2,000" in rec
+
+    def test_falls_back_to_static_artifact(self, tmp_path):
+        rec = bench.latest_tpu_record(base_dir=str(tmp_path))
+        assert "bench_flagship_tpu_20260730" in rec
+
+    def test_skips_cpu_and_zero_value_rows(self, tmp_path):
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        (bdir / "tpu_r5_results.jsonl").write_text(
+            '{"label": "flagship_gumbel_pcr", "result": {"value": 0.0,'
+            ' "extra": {"backend": "cpu"}}}\n'
+        )
+        (bdir / "tpu_r4_results.jsonl").write_text(
+            '{"label": "flagship_puct", "result": {"value": 1500.0,'
+            ' "extra": {"backend": "tpu"}}}\n'
+        )
+        rec = bench.latest_tpu_record(base_dir=str(tmp_path))
+        # r5's junk row skipped; r4's real TPU row cited.
+        assert "tpu_r4_results.jsonl" in rec and "1,500" in rec
+
+    def test_round_number_ordering_beats_mtime(self, tmp_path):
+        import os as _os
+
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        r4 = bdir / "tpu_r4_results_early.jsonl"
+        r5 = bdir / "tpu_r5_results.jsonl"
+        for p, v in ((r4, 1000.0), (r5, 2000.0)):
+            p.write_text(
+                '{"label": "flagship_gumbel_pcr", "result": '
+                f'{{"value": {v}, "extra": {{"backend": "tpu"}}}}}}\n'
+            )
+        # Simulate a fresh checkout flattening mtimes the wrong way.
+        _os.utime(r5, (1, 1))
+        rec = bench.latest_tpu_record(base_dir=str(tmp_path))
+        assert "tpu_r5_results.jsonl" in rec and "2,000" in rec
