@@ -15,8 +15,10 @@ to end.
 
 Measured 2026-07-31 (single-core host, so the virtual mesh adds
 overhead rather than speed — the point is semantics, not throughput):
-21.69 -> 23.08 greedy eval (+6.4%) in 1200 steps at replay ratio 0.44;
-the single-device reference reached 24.00 (+10.7%) at 4000 steps.
+21.69 -> 23.75 greedy eval (+9.5%) in 4000 steps at replay ratio 0.45
+— near-parity with the single-device reference (24.00, +10.7%) at the
+same step count despite half the gradient updates per experience; a
+1200-step run measured +6.4% en route.
 
 Usage:  python benchmarks/sharded_learning_proof.py
 Env:    PROOF_STEPS=N (default 1500), PROOF_EVAL_GAMES=N (default 256)
