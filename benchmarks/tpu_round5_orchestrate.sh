@@ -6,21 +6,45 @@
 #      + overlapped numbers, presets 2 and 4) — minutes each, resumable.
 #   2. The on-hardware training run (hours; checkpoint-stall watchdog
 #      inside tpu_training_run.py survives mid-run wedges).
-#   3. The remaining sweep sections (A/Bs, presets 3/5, profile).
+#   3. The subtree-reuse bet closure on the trained checkpoint.
+#   4. The remaining sweep sections (A/Bs, presets 3/5, profile).
 #
-# Every phase is resumable/idempotent, so the watcher can relaunch this
-# script across as many healthy windows as it takes.
+# ORCH_END_BY (epoch seconds, optional): hard runway limit — phases
+# that might not fit are skipped/capped so the chip is FREE by then
+# (the round driver runs its own bench at round end; two processes
+# contending for the single chip would turn its attempt into a CPU
+# fallback). Every phase is resumable/idempotent, so the watcher can
+# relaunch this script across as many healthy windows as it takes.
 set -u
 cd "$(dirname "$0")/.."
 
+end_by=${ORCH_END_BY:-0}
+runway() {
+  if [ "$end_by" -le 0 ]; then echo 999999; else
+    echo $(( end_by - $(date +%s) )); fi
+}
+
 KEY="flagship_gumbel_pcr flagship_puct preset2 preset4"
+[ "$(runway)" -gt 600 ] || { echo "orchestrator: out of runway" >&2; exit 1; }
 BENCH_SECTIONS="$KEY" bash benchmarks/tpu_round5.sh || exit 1
-python benchmarks/tpu_training_run.py --steps 2000 --kill-at 600 \
-  --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || exit 1
+
+r=$(runway)
+if [ "$r" -gt 1800 ]; then
+  # Cap the training run so the chip is free 10 min before end_by.
+  timeout $(( r - 600 )) python benchmarks/tpu_training_run.py \
+    --steps 2000 --kill-at 600 \
+    --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || exit 1
+else
+  echo "orchestrator: skipping training run (runway ${r}s)" >&2
+fi
+
 # Close the subtree-reuse bet with the just-trained checkpoint
 # (docs/MCTS_DESIGN.md §a's revisit criterion; VERDICT r5 item 6).
-if [ ! -f benchmarks/reuse_bet_results.json ]; then
-  timeout 2400 python benchmarks/reuse_bet_closure.py \
+if [ ! -f benchmarks/reuse_bet_results.json ] && [ "$(runway)" -gt 1500 ] \
+   && ls /tmp/tpu_r5_train/AlphaTriangleTPU/runs/tpu_flagship_r5/checkpoints/step_* >/dev/null 2>&1; then
+  timeout $(( $(runway) - 300 )) python benchmarks/reuse_bet_closure.py \
     --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || true
 fi
+
+[ "$(runway)" -gt 600 ] || exit 0
 bash benchmarks/tpu_round5.sh
