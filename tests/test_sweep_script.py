@@ -1,0 +1,144 @@
+"""The round-5 sweep script's window-critical logic, driven for real.
+
+benchmarks/tpu_round5.sh runs unattended in rare healthy-chip windows;
+a logic bug there silently wastes the round's one shot at hardware
+numbers. These tests copy the script into a sandbox with a stub
+bench.py and assert the behaviors the orchestration depends on:
+resume-skip, BENCH_SECTIONS filtering, and the refusal to record
+CPU-fallback or wedge-truncated partial rows.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+STUB_BENCH = """\
+import json, os, sys
+mode = os.environ.get("STUB_MODE", "tpu")
+label_hint = os.environ.get("BENCH_CONFIG", "") or os.environ.get(
+    "BENCH_RECIPE", ""
+)
+if mode == "tpu":
+    print(json.dumps({
+        "metric": "self_play_games_per_hour", "value": 1234.0,
+        "unit": "games/hour", "vs_baseline": 0.12,
+        "extra": {"backend": "tpu", "hint": label_hint},
+    }))
+elif mode == "cpu":
+    print(json.dumps({
+        "metric": "self_play_games_per_hour", "value": 99.0,
+        "unit": "games/hour", "vs_baseline": 0.01,
+        "extra": {"backend": "cpu"},
+    }))
+elif mode == "partial":
+    print(json.dumps({
+        "metric": "self_play_games_per_hour", "value": 777.0,
+        "unit": "games/hour", "vs_baseline": 0.08,
+        "extra": {"backend": "tpu", "partial": "self_play"},
+    }))
+elif mode == "silent":
+    pass
+sys.exit(0)
+"""
+
+
+@pytest.fixture()
+def sandbox(tmp_path):
+    bench_dir = tmp_path / "benchmarks"
+    bench_dir.mkdir()
+    script = (REPO / "benchmarks" / "tpu_round5.sh").read_text()
+    (bench_dir / "tpu_round5.sh").write_text(script)
+    (tmp_path / "bench.py").write_text(STUB_BENCH)
+    return tmp_path
+
+
+def run_sweep(sandbox, env=None, sections=None):
+    full_env = {
+        "PATH": "/usr/bin:/bin",
+        "HOME": str(sandbox),
+        "STUB_MODE": "tpu",
+    }
+    # The stub must run under THIS python; the script calls `python`.
+    bindir = sandbox / "bin"
+    bindir.mkdir(exist_ok=True)
+    link = bindir / "python"
+    if not link.exists():
+        link.symlink_to(sys.executable)
+    full_env["PATH"] = f"{bindir}:{full_env['PATH']}"
+    if sections is not None:
+        full_env["BENCH_SECTIONS"] = sections
+    full_env.update(env or {})
+    proc = subprocess.run(
+        ["bash", str(sandbox / "benchmarks" / "tpu_round5.sh")],
+        cwd=sandbox,
+        env=full_env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    out = sandbox / "benchmarks" / "tpu_r5_results.jsonl"
+    rows = (
+        [json.loads(x) for x in out.read_text().splitlines() if x.strip()]
+        if out.exists()
+        else []
+    )
+    return proc, rows
+
+
+def labels(rows):
+    return [r["label"] for r in rows]
+
+
+class TestSweepScript:
+    def test_sections_filter_limits_to_named(self, sandbox):
+        proc, rows = run_sweep(
+            sandbox, sections="flagship_gumbel_pcr preset2"
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert labels(rows) == ["flagship_gumbel_pcr", "preset2"]
+        # Per-section env vars reach the bench child (the stub echoes
+        # BENCH_CONFIG/BENCH_RECIPE back as extra.hint).
+        assert rows[1]["result"]["extra"]["hint"] == "2"
+
+    def test_full_sweep_records_every_section(self, sandbox):
+        proc, rows = run_sweep(sandbox)
+        assert proc.returncode == 0, proc.stderr
+        assert "sweep complete" in proc.stderr
+        got = labels(rows)
+        # Priority prefix the orchestrator depends on.
+        assert got[:4] == [
+            "flagship_gumbel_pcr", "flagship_puct", "preset2", "preset4",
+        ]
+        assert "flagship_profile" in got
+
+    def test_resume_skips_recorded_sections(self, sandbox):
+        run_sweep(sandbox, sections="flagship_gumbel_pcr")
+        proc, rows = run_sweep(
+            sandbox, sections="flagship_gumbel_pcr preset2"
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "already recorded" in proc.stderr
+        assert labels(rows) == ["flagship_gumbel_pcr", "preset2"]
+
+    def test_cpu_fallback_aborts_without_recording(self, sandbox):
+        proc, rows = run_sweep(sandbox, env={"STUB_MODE": "cpu"})
+        assert proc.returncode == 1
+        assert "backend != tpu" in proc.stderr
+        assert rows == []
+
+    def test_partial_row_aborts_without_recording(self, sandbox):
+        proc, rows = run_sweep(sandbox, env={"STUB_MODE": "partial"})
+        assert proc.returncode == 1
+        assert "partial" in proc.stderr
+        assert rows == []
+
+    def test_no_json_aborts(self, sandbox):
+        proc, rows = run_sweep(sandbox, env={"STUB_MODE": "silent"})
+        assert proc.returncode == 1
+        assert "no JSON" in proc.stderr
+        assert rows == []
