@@ -152,6 +152,22 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument(
+        "--no-telemetry",
+        action="store_true",
+        help="Disable the run-telemetry subsystem (span trace, "
+        "health.json heartbeat, stall watchdog, anomaly detection; "
+        "docs/OBSERVABILITY.md).",
+    )
+    p.add_argument(
+        "--watchdog-deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="Stall watchdog deadline: no learner step and no rollout "
+        "harvest for this long dumps thread stacks + flags the "
+        "heartbeat (default 300).",
+    )
 
 
 def merge_train_overrides(base_config, overrides: dict):
@@ -219,6 +235,17 @@ def cmd_train(args: argparse.Namespace) -> int:
     if args.device is not None:
         overrides["DEVICE"] = args.device
 
+    telemetry_config = None
+    if args.no_telemetry or args.watchdog_deadline is not None:
+        from .config import TelemetryConfig
+
+        t_kw: dict = {}
+        if args.no_telemetry:
+            t_kw["ENABLED"] = False
+        if args.watchdog_deadline is not None:
+            t_kw["WATCHDOG_DEADLINE_S"] = args.watchdog_deadline
+        telemetry_config = TelemetryConfig(**t_kw)
+
     env_config = model_config = mcts_config = mesh_config = None
     if args.preset is not None:
         from .config import baseline_preset
@@ -280,6 +307,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         mesh_config=mesh_config,
         persistence_config=persistence_config,
         distributed_config=distributed_config,
+        telemetry_config=telemetry_config,
         log_level=args.log_level,
         use_tensorboard=not args.no_tensorboard,
     )
@@ -328,6 +356,125 @@ def cmd_ml(args: argparse.Namespace) -> int:
     )
 
 
+def _resolve_run_dir(
+    run_name: str | None, root_dir: str | None
+) -> "Path | None":
+    """Run directory for a (run name, runs root) pair; latest run when
+    the name is omitted. Never imports JAX (safe beside a sick chip)."""
+    from .config import PersistenceConfig
+    from .stats.watch import find_latest_run_dir
+
+    persistence = PersistenceConfig(RUN_NAME=run_name or "latest")
+    if root_dir:
+        persistence = persistence.model_copy(
+            update={"ROOT_DATA_DIR": root_dir}
+        )
+    if run_name:
+        return persistence.get_run_base_dir()
+    run_dir = find_latest_run_dir(persistence.get_runs_root_dir())
+    if run_dir is None:
+        print(
+            f"no runs under {persistence.get_runs_root_dir()}",
+            file=sys.stderr,
+        )
+    return run_dir
+
+
+def cmd_health(args: argparse.Namespace) -> int:
+    """Heartbeat check for a run: pretty-print `health.json` + a
+    staleness verdict. Exit 0 = live, 1 = stalled/stale, 2 = no
+    heartbeat — so the bench supervisor (or a cron) can gate on it
+    without parsing anything."""
+    from .telemetry.health import health_verdict, read_health
+
+    run_dir = _resolve_run_dir(args.run, args.root_dir)
+    if run_dir is None:
+        return 2
+    path = run_dir / "health.json"
+    payload = read_health(path)
+    if payload is None:
+        print(f"no readable heartbeat at {path}", file=sys.stderr)
+        return 2
+    ok, age, reason = health_verdict(payload, deadline_s=args.deadline)
+    verdict = "LIVE" if ok else "STALLED"
+    print(f"run {payload.get('run') or run_dir.name}: {verdict} ({reason})")
+    print(
+        f"  heartbeat    {age:,.0f}s ago (pid {payload.get('pid')}, "
+        f"uptime {payload.get('uptime_s', 0):,.0f}s)"
+    )
+    learner_age = payload.get("learner_age_s")
+    rollout_age = payload.get("rollout_age_s")
+    print(
+        f"  learner      step {payload.get('learner_step', 0):,}"
+        + (
+            f", last step {learner_age:,.0f}s before the heartbeat"
+            if learner_age is not None
+            else " (no step yet)"
+        )
+    )
+    print(
+        f"  self-play    {payload.get('episodes_played', 0):,} episodes, "
+        f"{payload.get('experiences_added', 0):,} experiences"
+        + (
+            f", last harvest {rollout_age:,.0f}s before the heartbeat"
+            if rollout_age is not None
+            else ""
+        )
+    )
+    print(
+        f"  buffer       {payload.get('buffer_size', 0):,} | stalls "
+        f"{payload.get('stall_count', 0)} | deadline "
+        f"{payload.get('watchdog_deadline_s')}s"
+    )
+    for mem in payload.get("device_memory") or []:
+        in_use = mem.get("bytes_in_use") or 0
+        limit = mem.get("bytes_limit") or 0
+        pct = f" ({100.0 * in_use / limit:.0f}%)" if limit else ""
+        print(
+            f"  device {mem.get('device')} [{mem.get('kind')}]  "
+            f"{in_use / 2**30:.2f} GiB in use"
+            + (f" / {limit / 2**30:.2f} GiB{pct}" if limit else "")
+        )
+    return 0 if ok else 1
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Summarize a run's host span trace (`trace.json`): per-span-name
+    totals, busiest first, plus the file path for Perfetto/chrome
+    loading. The spans are wall-clock, so they line up with any
+    `--profile` xplane device traces from the same run."""
+    from .telemetry.tracer import summarize_trace_file
+
+    run_dir = _resolve_run_dir(args.run, args.root_dir)
+    if run_dir is None:
+        return 1
+    path = run_dir / "trace.json"
+    try:
+        rows = summarize_trace_file(path, top=args.top)
+    except (OSError, ValueError) as exc:
+        print(f"no readable span trace at {path} ({exc})", file=sys.stderr)
+        return 1
+    if not rows:
+        print(f"{path}: no complete spans recorded.")
+        return 0
+    width = max(max(len(r["name"]) for r in rows), 5)
+    print(
+        f"{'span':<{width}}  {'count':>7}  {'total s':>9}  "
+        f"{'mean ms':>9}  {'max ms':>9}  {'threads':>7}"
+    )
+    for r in rows:
+        print(
+            f"{r['name']:<{width}}  {r['count']:>7d}  "
+            f"{r['total_ms'] / 1e3:>9.2f}  {r['mean_ms']:>9.2f}  "
+            f"{r['max_ms']:>9.2f}  {r['threads']:>7d}"
+        )
+    print(
+        f"\nfull timeline: load {path} in https://ui.perfetto.dev "
+        "or chrome://tracing"
+    )
+    return 0
+
+
 def cmd_watch(args: argparse.Namespace) -> int:
     """Live-run console: tail a run's `live_metrics.jsonl` and render
     games/h, learner steps/s, replay ratio, staleness, queue depth —
@@ -336,30 +483,14 @@ def cmd_watch(args: argparse.Namespace) -> int:
     it is safe to run beside a training process on a sick-chip day."""
     import time as _time
 
-    from .config import PersistenceConfig
-    from .stats.watch import (
-        WatchState,
-        find_latest_run_dir,
-        render_frame,
-        tail_live_metrics,
-    )
+    from .stats.watch import WatchState, render_frame, tail_live_metrics
+    from .telemetry.health import read_health
 
-    persistence = PersistenceConfig(RUN_NAME=args.run_name or "latest")
-    if args.root_dir:
-        persistence = persistence.model_copy(
-            update={"ROOT_DATA_DIR": args.root_dir}
-        )
-    if args.run_name:
-        run_dir = persistence.get_run_base_dir()
-    else:
-        run_dir = find_latest_run_dir(persistence.get_runs_root_dir())
-        if run_dir is None:
-            print(
-                f"no runs under {persistence.get_runs_root_dir()}",
-                file=sys.stderr,
-            )
-            return 1
+    run_dir = _resolve_run_dir(args.run_name, args.root_dir)
+    if run_dir is None:
+        return 1
     live = run_dir / "live_metrics.jsonl"
+    heartbeat = run_dir / "health.json"
     state = WatchState()
     offset = tail_live_metrics(live, state, 0)
     if not live.exists():
@@ -367,7 +498,7 @@ def cmd_watch(args: argparse.Namespace) -> int:
             f"waiting for {live} (run still starting?) — Ctrl-C to stop",
             file=sys.stderr,
         )
-    frame = render_frame(state, run_dir.name)
+    frame = render_frame(state, run_dir.name, health=read_health(heartbeat))
     print(frame, flush=True)
     if args.once:
         return 0
@@ -377,7 +508,9 @@ def cmd_watch(args: argparse.Namespace) -> int:
             offset = tail_live_metrics(live, state, offset)
             # Redraw in place: move up over the previous frame.
             height = frame.count("\n") + 1
-            frame = render_frame(state, run_dir.name)
+            frame = render_frame(
+                state, run_dir.name, health=read_health(heartbeat)
+            )
             print(f"\x1b[{height}F\x1b[0J" + frame, flush=True)
     except KeyboardInterrupt:
         return 0
@@ -894,6 +1027,35 @@ def main(argv: list[str] | None = None) -> int:
         "--once", action="store_true", help="Render one frame and exit."
     )
 
+    health = sub.add_parser(
+        "health",
+        help="Heartbeat check: pretty-print a run's health.json with a "
+        "staleness verdict (exit 0 live / 1 stalled / 2 missing).",
+    )
+    health.add_argument(
+        "run", nargs="?", default=None, help="Run name (default: latest)."
+    )
+    health.add_argument("--root-dir", default=None)
+    health.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="Staleness deadline override (default: the run's "
+        "watchdog deadline).",
+    )
+
+    trace = sub.add_parser(
+        "trace",
+        help="Summarize a run's host span trace (trace.json; "
+        "Perfetto/chrome-loadable).",
+    )
+    trace.add_argument(
+        "run", nargs="?", default=None, help="Run name (default: latest)."
+    )
+    trace.add_argument("--root-dir", default=None)
+    trace.add_argument("--top", type=int, default=20)
+
     an = sub.add_parser(
         "analyze", help="Summarize per-phase timer dumps from a profile run."
     )
@@ -970,6 +1132,8 @@ def main(argv: list[str] | None = None) -> int:
         "ml": cmd_ml,
         "devices": cmd_devices,
         "watch": cmd_watch,
+        "health": cmd_health,
+        "trace": cmd_trace,
         "analyze": cmd_analyze,
         "eval": cmd_eval,
         "play": cmd_play,

@@ -10,6 +10,7 @@ from alphatriangle_tpu.config.presets import (
     PRESET_DESCRIPTIONS,
     baseline_preset,
 )
+from alphatriangle_tpu.config.telemetry_config import TelemetryConfig
 from alphatriangle_tpu.config.train_config import TrainConfig
 from alphatriangle_tpu.config.validation import (
     expected_other_features_dim,
@@ -25,6 +26,7 @@ __all__ = [
     "ModelConfig",
     "PRESET_DESCRIPTIONS",
     "PersistenceConfig",
+    "TelemetryConfig",
     "TrainConfig",
     "baseline_preset",
     "expected_other_features_dim",

@@ -1,0 +1,48 @@
+"""Run-telemetry configuration (span tracing, health/watchdog, anomaly
+detection — see `alphatriangle_tpu/telemetry/` and docs/OBSERVABILITY.md).
+
+Telemetry is on by default: every knob here bounds host-side memory or
+IO cadence, and nothing in the package touches the device dispatch path
+(span/beat ingestion is an O(1) append or field write under a lock; IO
+happens on loop ticks and watchdog polls only).
+"""
+
+from pydantic import BaseModel, Field
+
+
+class TelemetryConfig(BaseModel):
+    """Knobs for the telemetry subsystem."""
+
+    ENABLED: bool = Field(default=True)
+
+    # --- span tracer ---
+    # Ring capacity for in-memory spans; the newest SPAN_BUFFER_SIZE
+    # spans are exported to runs/<run>/trace.json at exit and on stall.
+    SPAN_BUFFER_SIZE: int = Field(default=65536, ge=1)
+
+    # --- health heartbeat + watchdog ---
+    # health.json is rewritten when the learner step advances, and at
+    # least this often while the loop ticks (so a stalled-but-alive run
+    # keeps a fresh heartbeat carrying its stall flag).
+    HEALTH_WRITE_INTERVAL_S: float = Field(default=5.0, gt=0)
+    WATCHDOG_ENABLED: bool = Field(default=True)
+    # No learner step AND no rollout harvest for this long => stall.
+    # Generous default: a flagship compile is ~70s and a rollout chunk
+    # is multi-second; 300s of neither is a wedged run, not a slow one.
+    WATCHDOG_DEADLINE_S: float = Field(default=300.0, gt=0)
+    WATCHDOG_POLL_S: float = Field(default=10.0, gt=0)
+    # On stall, also export the span ring to trace.json so the timeline
+    # leading INTO the stall is on disk before anyone kills the process.
+    FLUSH_TRACE_ON_STALL: bool = Field(default=True)
+
+    # --- anomaly detection ---
+    ANOMALY_ENABLED: bool = Field(default=True)
+    ANOMALY_EWMA_ALPHA: float = Field(default=0.02, gt=0, le=1.0)
+    ANOMALY_Z_THRESHOLD: float = Field(default=6.0, gt=0)
+    ANOMALY_WARMUP_STEPS: int = Field(default=20, ge=1)
+    ANOMALY_WINDOW: int = Field(default=32, ge=1)
+    # Policy entropy at/below this after warmup counts as a collapse.
+    ENTROPY_COLLAPSE_THRESHOLD: float = Field(default=0.01, ge=0)
+
+
+TelemetryConfig.model_rebuild(force=True)

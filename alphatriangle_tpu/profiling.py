@@ -87,6 +87,10 @@ class ProfileSession:
     first iteration so compilation doesn't dominate, and bounded so the
     trace stays a viewable size (the reference bounds its cProfile per
     episode for the same reason, `worker.py:172-173`).
+
+    When a `tracer` (telemetry.SpanTracer) is attached, every `phase`
+    also records an individual begin/end span — the per-occurrence
+    timeline next to these whole-run means.
     """
 
     def __init__(
@@ -95,16 +99,31 @@ class ProfileSession:
         profile_dir: Path,
         trace_start: int = 1,
         trace_stop: int = 3,
+        tracer=None,
     ) -> None:
+        if trace_stop <= trace_start:
+            # A window that never closes would silently trace the whole
+            # run into an unviewably large dump.
+            raise ValueError(
+                f"trace_stop={trace_stop} must be > trace_start="
+                f"{trace_start}"
+            )
         self.enabled = enabled
         self.profile_dir = Path(profile_dir)
         self.timers = PhaseTimers()
+        self.tracer = tracer
         self._trace_start = trace_start
         self._trace_stop = trace_stop
         self._tracing = False
 
+    @contextmanager
     def phase(self, name: str):
-        return self.timers.phase(name)
+        with self.timers.phase(name):
+            if self.tracer is not None:
+                with self.tracer.span(name):
+                    yield
+            else:
+                yield
 
     def on_iteration(self, iteration: int) -> None:
         """Called at the top of each loop iteration."""
@@ -129,13 +148,21 @@ class ProfileSession:
     def _stop_trace(self) -> None:
         import jax
 
-        jax.profiler.stop_trace()
+        # Cleared first: a failing stop_trace must not leave the session
+        # retrying forever (and close() must still dump the timers).
         self._tracing = False
+        jax.profiler.stop_trace()
         logger.info("Profiling: device trace written to %s.", self.profile_dir)
 
     def close(self) -> None:
         if self._tracing:
-            self._stop_trace()
+            try:
+                self._stop_trace()
+            except Exception:
+                logger.exception(
+                    "jax.profiler.stop_trace failed; dumping phase "
+                    "timers anyway."
+                )
         if self.enabled:
             self.timers.dump(self.profile_dir / "phase_timers.json")
 

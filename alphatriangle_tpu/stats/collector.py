@@ -58,6 +58,12 @@ class StatsCollector:
     ):
         self._lock = threading.Lock()
         self._pending: dict[str, list[tuple[int, float]]] = defaultdict(list)
+        # Non-finite observations are dropped from aggregation, but not
+        # silently: counted per metric name, surfaced as one cumulative
+        # `Stats/nonfinite_dropped` scalar on each tick, and warned once
+        # per name (a NaN loss is a training signal, not log noise).
+        self._nonfinite: dict[str, int] = defaultdict(int)
+        self._nonfinite_warned: set[str] = set()
         # In-memory aggregate history is a convenience for tests and the
         # console; TensorBoard owns the full series. Bound it so a 100k
         # step run doesn't grow without limit (0 = unbounded).
@@ -109,7 +115,18 @@ class StatsCollector:
 
     def log_event(self, event: RawMetricEvent) -> None:
         if not np.isfinite(event.value):
-            logger.debug("Dropping non-finite metric %s", event.name)
+            with self._lock:
+                self._nonfinite[event.name] += 1
+                first = event.name not in self._nonfinite_warned
+                if first:
+                    self._nonfinite_warned.add(event.name)
+            if first:
+                logger.warning(
+                    "Non-finite value for metric %s at step %d; dropping "
+                    "(further drops counted in Stats/nonfinite_dropped).",
+                    event.name,
+                    event.global_step,
+                )
             return
         with self._lock:
             self._pending[event.name].append((event.global_step, event.value))
@@ -131,6 +148,11 @@ class StatsCollector:
         """
         with self._lock:
             pending, self._pending = self._pending, defaultdict(list)
+            dropped = sum(self._nonfinite.values())
+        if dropped:
+            pending["Stats/nonfinite_dropped"].append(
+                (global_step, float(dropped))
+            )
         means: dict[str, float] = {}
         for name, obs in pending.items():
             if not obs:
@@ -206,6 +228,11 @@ class StatsCollector:
     def latest(self, name: str) -> float | None:
         series = self._history.get(name)
         return series[-1][1] if series else None
+
+    def nonfinite_dropped(self) -> dict[str, int]:
+        """Cumulative non-finite drop count per metric name."""
+        with self._lock:
+            return dict(self._nonfinite)
 
     def close(self) -> None:
         if self._writer is not None:

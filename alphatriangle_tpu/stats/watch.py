@@ -111,7 +111,33 @@ def _fmt(value: "float | None", spec: str = ",.1f", unit: str = "") -> str:
     return f"{value:{spec}}{unit}"
 
 
-def render_frame(state: WatchState, run_name: str) -> str:
+def health_line(health: "dict | None", now: "float | None" = None) -> "str | None":
+    """Render the heartbeat (`health.json`, telemetry.HealthMonitor) as
+    one liveness line with an explicit stall verdict: heartbeat age past
+    the watchdog deadline, or a watchdog-flagged stall, both render as
+    STALLED. None when no heartbeat exists (pre-telemetry run)."""
+    if not isinstance(health, dict) or "time" not in health:
+        return None
+    now = time.time() if now is None else now
+    age = max(0.0, now - float(health.get("time") or 0.0))
+    deadline = float(health.get("watchdog_deadline_s") or 300.0)
+    step = health.get("learner_step") or 0
+    if age > deadline:
+        return f"  health       STALLED (no heartbeat for {age:,.0f}s)"
+    if health.get("stalled"):
+        return (
+            "  health       STALLED (watchdog: no training progress; "
+            f"heartbeat {age:,.0f}s ago)"
+        )
+    return (
+        f"  health       live (heartbeat {age:,.0f}s ago, "
+        f"learner step {step:,})"
+    )
+
+
+def render_frame(
+    state: WatchState, run_name: str, health: "dict | None" = None
+) -> str:
     """One console frame: the run's vital signs, newest tick first."""
     m = state.latest
     age = state.age_seconds
@@ -139,6 +165,9 @@ def render_frame(state: WatchState, run_name: str) -> str:
         f"   producer restarts {_fmt(m.get('System/Producer_Restarts'), ',.0f')}"
         f"   full-search {_fmt(m.get('SelfPlay/Full_Search_Fraction'), ',.2f')}",
     ]
+    hline = health_line(health)
+    if hline is not None:
+        lines.append(hline)
     return "\n".join(lines)
 
 

@@ -1,0 +1,214 @@
+"""Run telemetry: span tracing, health/watchdog monitoring, anomaly
+detection (docs/OBSERVABILITY.md).
+
+Three cooperating pieces, all host-side and off the device dispatch
+path, bundled behind the `RunTelemetry` facade the training loop talks
+to:
+
+- `tracer.SpanTracer` — thread-aware begin/end spans (rollout chunk,
+  sample, learner dispatch/train, weight sync, checkpoint, fold),
+  ring-buffered and exported as Chrome/Perfetto `trace.json`.
+- `health.HealthMonitor` + `health.Watchdog` — a `health.json`
+  heartbeat updated each loop tick, and a stall watchdog that dumps all
+  thread stacks and flushes the span buffer when nothing progresses for
+  a deadline.
+- `anomaly.AnomalyDetector` — streaming EWMA/z-score checks over
+  per-step training metrics (loss spikes, grad-norm explosions,
+  non-finite values, policy-entropy collapse) escalated to `Anomaly/*`
+  metrics and warnings with recent-window context.
+
+Podracer-style stacks (arXiv:2104.06272) treat this visibility as a
+prerequisite for scaling an async producer/learner loop; the repo's own
+round-5 "10.3h with zero healthy windows" (BASELINE.md) is the local
+proof.
+"""
+
+import logging
+import time
+from pathlib import Path
+
+from ..config.telemetry_config import TelemetryConfig
+from .anomaly import Anomaly, AnomalyDetector
+from .health import (
+    HealthMonitor,
+    Watchdog,
+    dump_thread_stacks,
+    health_verdict,
+    read_health,
+)
+from .tracer import SpanTracer, summarize_trace_file
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Anomaly",
+    "AnomalyDetector",
+    "HealthMonitor",
+    "RunTelemetry",
+    "SpanTracer",
+    "TelemetryConfig",
+    "Watchdog",
+    "dump_thread_stacks",
+    "health_verdict",
+    "read_health",
+    "summarize_trace_file",
+]
+
+TRACE_FILENAME = "trace.json"
+HEALTH_FILENAME = "health.json"
+STACKS_FILENAME = "stall_stacks.txt"
+
+
+class RunTelemetry:
+    """One run's telemetry: tracer + heartbeat + watchdog + anomalies.
+
+    Constructed by `setup_training_components`, driven by the training
+    loop: `start()` when the loop begins, `on_rollout`/`on_learner_step`
+    as work lands (O(1), any thread), `on_tick` once per loop iteration
+    (the only place heartbeat IO happens), `close()` in the loop's
+    finally block. With `config.ENABLED` false every hook is a cheap
+    no-op and no files are written.
+    """
+
+    def __init__(
+        self,
+        config: TelemetryConfig | None = None,
+        run_dir: Path | str = ".",
+        stats=None,
+        run_name: str = "",
+        clock=time.monotonic,
+    ) -> None:
+        self.config = config or TelemetryConfig()
+        self.run_dir = Path(run_dir)
+        self.stats = stats
+        enabled = self.config.ENABLED
+        self.tracer = SpanTracer(
+            capacity=self.config.SPAN_BUFFER_SIZE, enabled=enabled
+        )
+        self.health = HealthMonitor(
+            self.run_dir / HEALTH_FILENAME,
+            deadline_s=self.config.WATCHDOG_DEADLINE_S,
+            run_name=run_name,
+            clock=clock,
+        )
+        self.anomaly = AnomalyDetector(
+            alpha=self.config.ANOMALY_EWMA_ALPHA,
+            z_threshold=self.config.ANOMALY_Z_THRESHOLD,
+            warmup=self.config.ANOMALY_WARMUP_STEPS,
+            window=self.config.ANOMALY_WINDOW,
+            entropy_floor=self.config.ENTROPY_COLLAPSE_THRESHOLD,
+        )
+        self.watchdog: Watchdog | None = None
+        if enabled and self.config.WATCHDOG_ENABLED:
+            self.watchdog = Watchdog(
+                self.health,
+                deadline_s=self.config.WATCHDOG_DEADLINE_S,
+                poll_s=self.config.WATCHDOG_POLL_S,
+                on_stall=self._on_stall,
+                clock=clock,
+            )
+        self._step = 0
+        self._last_write_mono = None
+        self._last_written_step: int | None = None
+        self._clock = clock
+        self._closed = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.ENABLED
+
+    # --- loop lifecycle ----------------------------------------------
+
+    def start(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.start()
+
+    def close(self, step: int | None = None) -> None:
+        """Stop the watchdog, write the final heartbeat + trace export."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if not self.enabled:
+            return
+        if step is not None:
+            self._step = step
+        self.health.write()
+        n = self.tracer.export(self.run_dir / TRACE_FILENAME)
+        logger.info(
+            "Telemetry: %d span(s) -> %s, heartbeat -> %s",
+            n,
+            self.run_dir / TRACE_FILENAME,
+            self.health.path,
+        )
+
+    # --- beats (any thread, O(1) — no IO) ----------------------------
+
+    def on_rollout(self, experiences: int = 0, episodes: int = 0) -> None:
+        if self.enabled:
+            self.health.note_rollout(experiences, episodes)
+
+    def on_learner_step(self, step: int, metrics: dict) -> list[Anomaly]:
+        """Record learner progress and screen this step's metrics.
+
+        `metrics` uses the stats-pipeline names (`Loss/total_loss`,
+        `Loss/Grad_Norm`, `Loss/Entropy`, ...). Returns the anomalies
+        (already escalated to `Anomaly/*` metrics + warnings).
+        """
+        self._step = step
+        if not self.enabled:
+            return []
+        self.health.note_learner_step(step)
+        if not self.config.ANOMALY_ENABLED:
+            return []
+        anomalies = []
+        for name, value in metrics.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            anomalies.extend(self.anomaly.observe(name, value, step))
+        for a in anomalies:
+            logger.warning("Training anomaly: %s", a.describe())
+            if self.stats is not None:
+                self.stats.log_scalar(f"Anomaly/{a.kind}", 1.0, step)
+        return anomalies
+
+    # --- per-iteration tick (the only heartbeat IO site) --------------
+
+    def on_tick(self, step: int, buffer_size: int = 0) -> None:
+        if not self.enabled:
+            return
+        self._step = step
+        self.health.note_buffer(buffer_size)
+        now = self._clock()
+        due = (
+            self._last_write_mono is None
+            or step != self._last_written_step
+            or now - self._last_write_mono
+            >= self.config.HEALTH_WRITE_INTERVAL_S
+        )
+        if due:
+            self._last_write_mono = now
+            self._last_written_step = step
+            self.health.write()
+
+    # --- stall reaction ----------------------------------------------
+
+    def _on_stall(self, age_s: float) -> None:
+        """Watchdog hook: make the stall a diagnosable artifact."""
+        dump_thread_stacks(self.run_dir / STACKS_FILENAME)
+        self.tracer.instant("watchdog_stall", age_s=round(age_s, 1))
+        if self.stats is not None:
+            # Lands in TensorBoard on the next tick IF the loop ever
+            # ticks again; health.json carries the flag regardless.
+            self.stats.log_scalar("Health/stall", age_s, self._step)
+        if self.config.FLUSH_TRACE_ON_STALL:
+            self.tracer.export(self.run_dir / TRACE_FILENAME)
+        self.health.write()
+        logger.warning(
+            "Watchdog: thread stacks -> %s, span trace -> %s",
+            self.run_dir / STACKS_FILENAME,
+            self.run_dir / TRACE_FILENAME,
+        )

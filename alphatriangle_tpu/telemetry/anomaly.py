@@ -1,0 +1,151 @@
+"""Streaming training-anomaly detection over per-step metrics.
+
+A diverging run announces itself in the per-step series long before the
+aggregate TensorBoard means do: a loss spike, a grad-norm explosion, a
+NaN, policy entropy pinned at zero. The detector keeps EWMA mean/variance
+per metric (O(1) per observation, no history scan) and fires structured
+anomalies that the telemetry layer escalates to `Anomaly/*` metrics and
+log warnings with recent-window context.
+
+Checks per observation:
+- **nonfinite**: NaN/inf value (never folded into the running stats).
+- **spike**: |value - ewma_mean| exceeds `z_threshold` sigmas once the
+  metric has `warmup` observations. The scale gets a small absolute +
+  relative floor so a near-constant series (variance ~ 0) doesn't fire
+  on float jitter; a genuinely noisy-but-stationary series stays quiet
+  because the EWMA variance tracks its actual spread.
+- **collapse**: an entropy-like metric at/below the collapse floor
+  (policy entropy hitting ~0 means the policy head has saturated and
+  self-play exploration is gone). Latched: fires once per excursion,
+  re-arms when the metric recovers.
+"""
+
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+EPS_ABS = 1e-8  # scale floors: keep z finite on constant series
+EPS_REL = 1e-3
+
+
+@dataclass
+class Anomaly:
+    """One detected anomaly, with recent-window context for the log."""
+
+    kind: str  # "nonfinite" | "spike" | "collapse"
+    metric: str
+    step: int
+    value: float
+    zscore: float | None = None
+    mean: float | None = None
+    window: list = field(default_factory=list)  # recent (step, value)
+
+    def describe(self) -> str:
+        parts = [f"{self.kind} on {self.metric} at step {self.step}"]
+        if self.kind == "spike" and self.zscore is not None:
+            parts.append(
+                f"value {self.value:.6g} is {self.zscore:.1f} sigma from "
+                f"ewma mean {self.mean:.6g}"
+            )
+        elif self.kind == "collapse":
+            parts.append(f"value {self.value:.6g} at/below collapse floor")
+        else:
+            parts.append(f"value {self.value!r}")
+        if self.window:
+            recent = ", ".join(f"{v:.4g}" for _, v in self.window[-8:])
+            parts.append(f"recent: [{recent}]")
+        return "; ".join(parts)
+
+
+class _MetricState:
+    __slots__ = ("mean", "var", "n", "recent", "collapsed")
+
+    def __init__(self, window: int) -> None:
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.recent: deque = deque(maxlen=window)
+        self.collapsed = False
+
+
+class AnomalyDetector:
+    """Per-metric EWMA z-score + collapse checks, thread-safe."""
+
+    def __init__(
+        self,
+        alpha: float = 0.02,
+        z_threshold: float = 6.0,
+        warmup: int = 20,
+        window: int = 32,
+        entropy_floor: float = 0.01,
+        entropy_metrics: tuple[str, ...] = ("Loss/Entropy",),
+    ) -> None:
+        self.alpha = alpha
+        self.z_threshold = z_threshold
+        self.warmup = warmup
+        self.window = window
+        self.entropy_floor = entropy_floor
+        self.entropy_metrics = set(entropy_metrics)
+        self._lock = threading.Lock()
+        self._state: dict[str, _MetricState] = {}
+
+    def observe(self, metric: str, value: float, step: int) -> list[Anomaly]:
+        """Fold one observation; returns anomalies fired by it."""
+        value = float(value)
+        with self._lock:
+            st = self._state.get(metric)
+            if st is None:
+                st = self._state[metric] = _MetricState(self.window)
+            out: list[Anomaly] = []
+            ctx = list(st.recent)
+            if not math.isfinite(value):
+                # Not folded into the EWMA: one NaN must not poison the
+                # baseline the next finite values are judged against.
+                return [
+                    Anomaly("nonfinite", metric, step, value, window=ctx)
+                ]
+            if st.n >= self.warmup:
+                scale = (
+                    math.sqrt(max(st.var, 0.0))
+                    + EPS_ABS
+                    + EPS_REL * abs(st.mean)
+                )
+                z = abs(value - st.mean) / scale
+                if z > self.z_threshold:
+                    out.append(
+                        Anomaly(
+                            "spike", metric, step, value,
+                            zscore=z, mean=st.mean, window=ctx,
+                        )
+                    )
+            if metric in self.entropy_metrics and st.n >= self.warmup:
+                if value <= self.entropy_floor:
+                    if not st.collapsed:
+                        st.collapsed = True
+                        out.append(
+                            Anomaly(
+                                "collapse", metric, step, value,
+                                mean=st.mean, window=ctx,
+                            )
+                        )
+                else:
+                    st.collapsed = False
+            # EWMA update. During warmup the effective alpha decays as
+            # 1/(n+1), so the early estimates behave like plain sample
+            # mean/variance instead of over-weighting the first value.
+            a = max(self.alpha, 1.0 / (st.n + 1))
+            d = value - st.mean
+            st.mean += a * d
+            st.var = (1.0 - a) * (st.var + a * d * d)
+            st.n += 1
+            st.recent.append((step, value))
+            return out
+
+    def observe_metrics(
+        self, metrics: dict[str, float], step: int
+    ) -> list[Anomaly]:
+        out: list[Anomaly] = []
+        for name, value in metrics.items():
+            out.extend(self.observe(name, value, step))
+        return out

@@ -1,0 +1,287 @@
+"""Run liveness: heartbeat file + stall watchdog.
+
+The round-5 baseline recorded a 10.3-hour window in which nothing
+progressed and nothing said so (BASELINE.md). This module turns that
+silent failure mode into a diagnosable artifact:
+
+- `HealthMonitor`: subsystems beat it (learner step landed, rollout
+  harvest folded) with O(1) lock-guarded field updates off the device
+  path; each loop tick it writes `health.json` into the run dir — last
+  learner step, last-progress ages, buffer size, per-device memory via
+  `jax.local_devices()[*].memory_stats()`, wall + monotonic stamps.
+  Written atomically, readable by processes that never import JAX
+  (`alphatriangle-tpu health`, `cli watch`, the bench supervisor).
+- `Watchdog`: a daemon thread that compares monotonic now against the
+  last recorded progress; past the deadline it fires ONCE per stall —
+  dumping every thread's stack via `faulthandler` into the run dir,
+  marking the heartbeat stalled, and running a caller hook (metric +
+  span-buffer flush, wired in `RunTelemetry`) — then re-arms when
+  progress resumes. The clock is injectable so tests freeze it.
+
+File readers: a heartbeat older than the deadline means the *process*
+is dead or wedged (even the tick loop stopped); a fresh heartbeat with
+`stalled: true` means the process is alive but neither the learner nor
+the producers have made progress for a deadline.
+"""
+
+import faulthandler
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device memory snapshot; [] wherever the backend (e.g. CPU)
+    doesn't report. Imports jax lazily so heartbeat READERS never pay
+    for (or hang on) accelerator init."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            out.append(
+                {
+                    "device": d.id,
+                    "kind": getattr(d, "device_kind", d.platform),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                }
+            )
+        return out
+    except Exception:
+        return []
+
+
+class HealthMonitor:
+    """Lock-guarded liveness state + atomic `health.json` writer."""
+
+    def __init__(
+        self,
+        path: Path,
+        deadline_s: float = 300.0,
+        run_name: str = "",
+        clock=time.monotonic,
+    ) -> None:
+        self.path = Path(path)
+        self.deadline_s = deadline_s
+        self.run_name = run_name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._started = clock()
+        self._learner_step = 0
+        self._last_learner: float | None = None
+        self._last_rollout: float | None = None
+        self._buffer_size = 0
+        self._episodes = 0
+        self._experiences = 0
+        self._stalled = False
+        self._stall_count = 0
+
+    # --- beats (any thread, O(1)) -------------------------------------
+
+    def note_learner_step(self, step: int) -> None:
+        with self._lock:
+            self._learner_step = step
+            self._last_learner = self._clock()
+
+    def note_rollout(self, experiences: int = 0, episodes: int = 0) -> None:
+        with self._lock:
+            self._last_rollout = self._clock()
+            self._experiences += experiences
+            self._episodes += episodes
+
+    def note_buffer(self, size: int) -> None:
+        with self._lock:
+            self._buffer_size = size
+
+    def set_stalled(self, stalled: bool) -> None:
+        with self._lock:
+            if stalled and not self._stalled:
+                self._stall_count += 1
+            self._stalled = stalled
+
+    # --- queries ------------------------------------------------------
+
+    def last_progress(self) -> float:
+        """Monotonic time of the most recent learner/rollout progress
+        (run start before either has happened)."""
+        with self._lock:
+            return max(
+                self._started,
+                self._last_learner or self._started,
+                self._last_rollout or self._started,
+            )
+
+    def snapshot(self) -> dict:
+        """The heartbeat payload (ages computed at snapshot time)."""
+        now = self._clock()
+        with self._lock:
+            return {
+                "run": self.run_name,
+                "pid": os.getpid(),
+                "time": time.time(),
+                "monotonic": now,
+                "uptime_s": round(now - self._started, 3),
+                "learner_step": self._learner_step,
+                "learner_age_s": (
+                    round(now - self._last_learner, 3)
+                    if self._last_learner is not None
+                    else None
+                ),
+                "rollout_age_s": (
+                    round(now - self._last_rollout, 3)
+                    if self._last_rollout is not None
+                    else None
+                ),
+                "buffer_size": self._buffer_size,
+                "episodes_played": self._episodes,
+                "experiences_added": self._experiences,
+                "stalled": self._stalled,
+                "stall_count": self._stall_count,
+                "watchdog_deadline_s": self.deadline_s,
+                "device_memory": device_memory_stats(),
+            }
+
+    def write(self) -> None:
+        """Atomic heartbeat write; failures logged, never raised."""
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(self.snapshot(), indent=2))
+            tmp.replace(self.path)
+        except OSError:
+            logger.exception("heartbeat write to %s failed", self.path)
+
+
+class Watchdog:
+    """Fires once per stall when no progress beats for `deadline_s`."""
+
+    def __init__(
+        self,
+        health: HealthMonitor,
+        deadline_s: float,
+        poll_s: float = 10.0,
+        on_stall=None,
+        on_recover=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.health = health
+        self.deadline_s = deadline_s
+        self.poll_s = poll_s
+        self.on_stall = on_stall
+        self.on_recover = on_recover
+        self._clock = clock
+        self._stalled = False
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check(self, now: float | None = None) -> bool:
+        """One stall evaluation; returns whether currently stalled.
+        Called by the poll thread, and directly by tests (frozen clock).
+        """
+        now = self._clock() if now is None else now
+        age = now - self.health.last_progress()
+        if age > self.deadline_s:
+            if not self._stalled:
+                self._stalled = True
+                self.stall_count += 1
+                self.health.set_stalled(True)
+                logger.warning(
+                    "Watchdog: no learner/rollout progress for %.0fs "
+                    "(deadline %.0fs).",
+                    age,
+                    self.deadline_s,
+                )
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(age)
+                    except Exception:
+                        logger.exception("watchdog on_stall hook failed")
+        elif self._stalled:
+            self._stalled = False
+            self.health.set_stalled(False)
+            logger.info("Watchdog: progress resumed; stall cleared.")
+            if self.on_recover is not None:
+                try:
+                    self.on_recover()
+                except Exception:
+                    logger.exception("watchdog on_recover hook failed")
+        return self._stalled
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def dump_thread_stacks(path: Path) -> None:
+    """Append every thread's current stack to `path` (faulthandler)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("a") as f:
+        f.write(
+            f"=== stall at {time.strftime('%Y-%m-%d %H:%M:%S')} "
+            f"(pid {os.getpid()}) ===\n"
+        )
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.write("\n")
+
+
+# --- heartbeat readers (no JAX import anywhere on this path) ------------
+
+
+def read_health(path: Path) -> dict | None:
+    """Parse a heartbeat file; None when missing or torn."""
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def health_verdict(
+    payload: dict,
+    now: float | None = None,
+    deadline_s: float | None = None,
+) -> tuple[bool, float, str]:
+    """(live, heartbeat_age_s, reason) for a heartbeat payload.
+
+    Stale heartbeat => the writing process is dead or fully wedged;
+    fresh heartbeat with `stalled` set => alive but making no progress.
+    Either way the run needs attention (CLI exits non-zero).
+    """
+    now = time.time() if now is None else now
+    deadline = (
+        deadline_s
+        if deadline_s is not None
+        else float(payload.get("watchdog_deadline_s") or 300.0)
+    )
+    age = max(0.0, now - float(payload.get("time") or 0.0))
+    if age > deadline:
+        return False, age, f"no heartbeat for {age:.0f}s"
+    if payload.get("stalled"):
+        return False, age, "watchdog flagged a stall (no training progress)"
+    return True, age, "live"

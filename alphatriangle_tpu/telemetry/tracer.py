@@ -1,0 +1,202 @@
+"""Host-side span tracer: begin/end spans -> Chrome/Perfetto trace.json.
+
+`PhaseTimers` (profiling.py) answers "how much time did phase X take
+over the whole run" — a lossy mean that cannot say *where* a specific
+stall happened. The tracer keeps the individual spans: every rollout
+chunk, sample, learner dispatch/finish, weight sync and checkpoint is
+recorded with its real wall-clock begin/end and thread id, ring-buffered
+in memory (O(1) append under a lock, no IO on the hot path) and exported
+as Chrome trace events into the run dir. Wall-clock timestamps line up
+with the `jax.profiler` xplane traces written under `--profile`, so the
+host timeline and the device timeline can be read side by side.
+
+Load `trace.json` in chrome://tracing or https://ui.perfetto.dev, or
+summarize it in-terminal with `alphatriangle-tpu trace <run>`.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# A span record: (name, begin_ns, duration_ns, thread_id, thread_name,
+# args-or-None). `kind` "X" (complete span) or "i" (instant event,
+# duration 0) per the Chrome trace event format.
+_COMPLETE = "X"
+_INSTANT = "i"
+
+
+class SpanTracer:
+    """Thread-aware ring buffer of named wall-clock spans.
+
+    Ingestion is a timestamp read plus one deque append under a lock —
+    safe from any thread (rollout producers, the learner/consumer, the
+    watchdog) and cheap enough to run always-on. The ring bounds memory:
+    a multi-day run keeps the most recent `capacity` spans, which is
+    exactly the window that matters when diagnosing where it stalled.
+    """
+
+    def __init__(self, capacity: int = 65536, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max(1, capacity))
+        self.recorded = 0  # total ever recorded (ring may have evicted)
+
+    # --- ingestion (any thread, O(1)) ---------------------------------
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Record one complete span around the with-body."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time_ns()
+        try:
+            yield
+        finally:
+            dur = time.time_ns() - t0
+            thread = threading.current_thread()
+            with self._lock:
+                self._spans.append(
+                    (_COMPLETE, name, t0, dur, thread.ident, thread.name,
+                     args or None)
+                )
+                self.recorded += 1
+
+    def instant(self, name: str, **args) -> None:
+        """Record a zero-duration marker (e.g. a watchdog stall)."""
+        if not self.enabled:
+            return
+        thread = threading.current_thread()
+        with self._lock:
+            self._spans.append(
+                (_INSTANT, name, time.time_ns(), 0, thread.ident,
+                 thread.name, args or None)
+            )
+            self.recorded += 1
+
+    # --- export / summary ---------------------------------------------
+
+    def _snapshot(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def export(self, path: Path) -> int:
+        """Write the buffered spans as a Chrome trace; returns the event
+        count. Atomic (tmp + rename) so a reader never sees a torn file;
+        IO failures are logged, never raised (observability is not
+        allowed to kill a run)."""
+        spans = self._snapshot()
+        pid = os.getpid()
+        events = []
+        thread_names: dict[int, str] = {}
+        for kind, name, t0_ns, dur_ns, tid, tname, args in spans:
+            thread_names.setdefault(tid, tname)
+            ev = {
+                "name": name,
+                "ph": kind,
+                "ts": t0_ns // 1000,  # Chrome traces use microseconds
+                "pid": pid,
+                "tid": tid,
+                "cat": "host",
+            }
+            if kind == _COMPLETE:
+                ev["dur"] = dur_ns // 1000
+            else:
+                ev["s"] = "g"  # global-scope instant
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+            for tid, tname in sorted(thread_names.items())
+        ]
+        payload = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"recorded": self.recorded, "exported": len(events)},
+        }
+        try:
+            path = Path(path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(payload))
+            tmp.replace(path)
+        except OSError:
+            logger.exception("span trace export to %s failed", path)
+            return 0
+        if self.recorded > len(spans):
+            logger.info(
+                "span trace: ring kept the newest %d of %d spans.",
+                len(spans),
+                self.recorded,
+            )
+        return len(events)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-name aggregate of the buffered spans (count/total/mean/max)."""
+        total_ns: dict[str, int] = defaultdict(int)
+        max_ns: dict[str, int] = defaultdict(int)
+        count: dict[str, int] = defaultdict(int)
+        for kind, name, _t0, dur_ns, _tid, _tname, _args in self._snapshot():
+            if kind != _COMPLETE:
+                continue
+            total_ns[name] += dur_ns
+            max_ns[name] = max(max_ns[name], dur_ns)
+            count[name] += 1
+        return {
+            name: {
+                "count": count[name],
+                "total_ms": total_ns[name] / 1e6,
+                "mean_ms": total_ns[name] / 1e6 / max(count[name], 1),
+                "max_ms": max_ns[name] / 1e6,
+            }
+            for name in sorted(total_ns)
+        }
+
+
+def summarize_trace_file(path: Path, top: int = 20) -> list[dict]:
+    """Aggregate a `trace.json` (this tracer's or any Chrome trace) into
+    per-name rows, busiest first. Accepts both the object form
+    ({"traceEvents": [...]}) and the bare-array form. Raises OSError /
+    ValueError on unreadable input — the CLI maps that to exit 1."""
+    data = json.loads(Path(path).read_text())
+    events = data.get("traceEvents", []) if isinstance(data, dict) else data
+    total_us: dict[str, float] = defaultdict(float)
+    max_us: dict[str, float] = defaultdict(float)
+    count: dict[str, int] = defaultdict(int)
+    threads: dict[str, set] = defaultdict(set)
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != _COMPLETE:
+            continue
+        name = ev.get("name", "?")
+        dur = float(ev.get("dur", 0))
+        total_us[name] += dur
+        max_us[name] = max(max_us[name], dur)
+        count[name] += 1
+        threads[name].add(ev.get("tid"))
+    rows = [
+        {
+            "name": name,
+            "count": count[name],
+            "total_ms": total_us[name] / 1e3,
+            "mean_ms": total_us[name] / 1e3 / max(count[name], 1),
+            "max_ms": max_us[name] / 1e3,
+            "threads": len(threads[name]),
+        }
+        for name in total_us
+    ]
+    rows.sort(key=lambda r: r["total_ms"], reverse=True)
+    return rows[:top]

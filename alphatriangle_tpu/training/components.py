@@ -8,6 +8,7 @@ from ..config.mcts_config import MCTSConfig
 from ..config.mesh_config import MeshConfig
 from ..config.model_config import ModelConfig
 from ..config.persistence_config import PersistenceConfig
+from ..config.telemetry_config import TelemetryConfig
 from ..config.train_config import TrainConfig
 from ..env.engine import TriangleEnv
 from ..features.core import FeatureExtractor
@@ -17,6 +18,7 @@ from ..rl.self_play import SelfPlayEngine
 from ..rl.trainer import Trainer
 from ..stats.collector import StatsCollector
 from ..stats.persistence import CheckpointManager
+from ..telemetry import RunTelemetry
 
 
 @dataclass
@@ -38,5 +40,10 @@ class TrainingComponents:
     mcts_config: MCTSConfig
     mesh_config: MeshConfig
     persistence_config: PersistenceConfig
+
+    # Built by setup; a None (manually assembled components) makes the
+    # loop create a default-enabled RunTelemetry itself.
+    telemetry: RunTelemetry | None = None
+    telemetry_config: TelemetryConfig | None = None
 
     extra: dict[str, Any] = field(default_factory=dict)

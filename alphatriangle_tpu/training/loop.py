@@ -34,6 +34,7 @@ import numpy as np
 
 from ..profiling import ProfileSession
 from ..stats.events import RawMetricEvent
+from ..telemetry import RunTelemetry
 from ..utils.helpers import format_eta
 from .components import TrainingComponents
 
@@ -88,12 +89,24 @@ class TrainingLoop:
         self._cadence_anchor = 0  # resume step; cadence baseline
         self._last_progress_time = time.monotonic()
         self._last_progress_step = 0
+        # Telemetry (span tracer + heartbeat + watchdog + anomaly
+        # screening) always runs unless configured off; manually
+        # assembled components get a default instance.
+        self.telemetry = components.telemetry or RunTelemetry(
+            components.telemetry_config,
+            run_dir=components.persistence_config.get_run_base_dir(),
+            stats=components.stats,
+            run_name=components.persistence_config.RUN_NAME,
+        )
+        components.telemetry = self.telemetry
         # Per-phase timers always run (ns-level overhead); the device
         # trace + metric export + json dump activate under --profile
         # (reference `worker.py:99-104`, TrainConfig.PROFILE_WORKERS).
+        # The attached tracer records each phase occurrence as a span.
         self.profile = ProfileSession(
             enabled=self.cfg.PROFILE_WORKERS,
             profile_dir=components.persistence_config.get_profile_dir(),
+            tracer=self.telemetry.tracer,
         )
         if self.cfg.FUSED_LEARNER_STEPS > self.cfg.WORKER_UPDATE_FREQ_STEPS:
             logger.warning(
@@ -247,6 +260,7 @@ class TrainingLoop:
                 )
         c.stats.log_batch_events(events)
         self.experiences_added += added
+        self.telemetry.on_rollout(added, result.num_episodes)
         return added
 
     def _record_step(self, metrics: dict, td_errors, indices, step: int) -> None:
@@ -291,6 +305,21 @@ class TrainingLoop:
                 )
             )
         c.stats.log_batch_events(events)
+        # Liveness beat + streaming anomaly screen (loss spikes,
+        # grad-norm explosions, non-finite values, entropy collapse)
+        # over this step's metrics, under their stats-pipeline names.
+        self.telemetry.on_learner_step(
+            step,
+            {
+                **{
+                    f"Loss/{key}": val
+                    for key, val in metrics.items()
+                    if key.endswith("loss")
+                },
+                "Loss/Grad_Norm": metrics["grad_norm"],
+                "Loss/Entropy": metrics["entropy"],
+            },
+        )
 
     def _maybe_sync_weights(self, prev_step: int) -> None:
         """Push learner params when (prev_step, global_step] crossed a
@@ -302,7 +331,8 @@ class TrainingLoop:
         sync cadence is the group size (warned at loop start)."""
         freq = self.cfg.WORKER_UPDATE_FREQ_STEPS
         if self._crossed(self.global_step, freq, prev_step):
-            self.c.trainer.sync_to_network()
+            with self.profile.phase("weight_sync"):
+                self.c.trainer.sync_to_network()
             self.weight_updates += 1
             self.c.stats.log_scalar(
                 "Progress/Weight_Updates_Total",
@@ -506,6 +536,7 @@ class TrainingLoop:
         """Run until MAX_TRAINING_STEPS / stop / error
         (reference `loop.py:298-416`)."""
         status = LoopStatus.COMPLETED
+        self.telemetry.start()
         try:
             if self.cfg.ASYNC_ROLLOUTS:
                 self._run_async()
@@ -527,6 +558,12 @@ class TrainingLoop:
             except Exception:
                 logger.exception("Final save failed.")
                 status = LoopStatus.ERROR
+            # Last: the final heartbeat + span-trace export cover the
+            # shutdown work above too.
+            try:
+                self.telemetry.close(self.global_step)
+            except Exception:
+                logger.exception("Telemetry shutdown failed.")
         return status
 
     def _run_sync(self) -> None:
@@ -1000,5 +1037,8 @@ class TrainingLoop:
         if self.cfg.PROFILE_WORKERS:
             for name, val in self.profile.timers.metrics().items():
                 self.c.stats.log_scalar(name, val, self.global_step)
+        # Heartbeat write (health.json) — before the stats tick so any
+        # Anomaly/* or Health/* events logged this iteration flush too.
+        self.telemetry.on_tick(self.global_step, len(self.c.buffer))
         self.c.stats.process_and_log(self.global_step)
         self._log_progress()

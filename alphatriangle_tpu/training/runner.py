@@ -14,6 +14,7 @@ from ..config.mcts_config import MCTSConfig
 from ..config.mesh_config import MeshConfig
 from ..config.model_config import ModelConfig
 from ..config.persistence_config import PersistenceConfig
+from ..config.telemetry_config import TelemetryConfig
 from ..config.train_config import TrainConfig
 from ..logging_config import setup_logging
 from ..parallel.distributed import (
@@ -64,6 +65,7 @@ def run_training(
     mesh_config: MeshConfig | None = None,
     persistence_config: PersistenceConfig | None = None,
     distributed_config: DistributedConfig | None = None,
+    telemetry_config: TelemetryConfig | None = None,
     log_level: str = "INFO",
     use_tensorboard: bool = True,
 ) -> int:
@@ -110,6 +112,7 @@ def run_training(
             mcts_config=mcts_config,
             mesh_config=mesh_config,
             persistence_config=persistence_config,
+            telemetry_config=telemetry_config,
             use_tensorboard=use_tensorboard,
         )
     except Exception:

@@ -19,6 +19,7 @@ from ..config.mesh_config import (
 )
 from ..config.model_config import ModelConfig
 from ..config.persistence_config import PersistenceConfig
+from ..config.telemetry_config import TelemetryConfig
 from ..config.train_config import TrainConfig
 from ..config.validation import print_config_info_and_validate
 from ..env.engine import TriangleEnv
@@ -30,6 +31,7 @@ from ..rl.self_play import SelfPlayEngine
 from ..rl.trainer import Trainer
 from ..stats.collector import StatsCollector
 from ..stats.persistence import CheckpointManager
+from ..telemetry import RunTelemetry
 from .components import TrainingComponents
 
 logger = logging.getLogger(__name__)
@@ -183,6 +185,7 @@ def setup_training_components(
     mcts_config: MCTSConfig | None = None,
     mesh_config: MeshConfig | None = None,
     persistence_config: PersistenceConfig | None = None,
+    telemetry_config: TelemetryConfig | None = None,
     use_tensorboard: bool = True,
 ) -> TrainingComponents:
     """Validate configs and build every training component."""
@@ -293,6 +296,20 @@ def setup_training_components(
         use_live_file=is_primary(),
     )
     checkpoints = CheckpointManager(persistence_config)
+    # Telemetry (spans + heartbeat + watchdog + anomaly screening) is a
+    # primary-process concern like the live file: N hosts rewriting one
+    # shared health.json would interleave diverging heartbeats.
+    telemetry_config = telemetry_config or TelemetryConfig()
+    if not is_primary():
+        telemetry_config = telemetry_config.model_copy(
+            update={"ENABLED": False}
+        )
+    telemetry = RunTelemetry(
+        telemetry_config,
+        run_dir=persistence_config.get_run_base_dir(),
+        stats=stats,
+        run_name=persistence_config.RUN_NAME,
+    )
     all_configs = {
         "env": env_config,
         "model": model_config,
@@ -300,6 +317,7 @@ def setup_training_components(
         "mcts": mcts_config,
         "mesh": mesh_config,
         "persistence": persistence_config,
+        "telemetry": telemetry_config,
     }
     checkpoints.save_configs(all_configs)
     # Experiment-param channel (reference `logging_utils.py:13-35`).
@@ -325,4 +343,6 @@ def setup_training_components(
         mcts_config=mcts_config,
         mesh_config=mesh_config,
         persistence_config=persistence_config,
+        telemetry=telemetry,
+        telemetry_config=telemetry_config,
     )

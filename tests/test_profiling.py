@@ -82,6 +82,50 @@ class TestProfileSession:
         assert (tmp_path / "p" / "phase_timers.json").exists()
         assert list((tmp_path / "p").glob("**/*.xplane.pb"))
 
+    def test_close_dumps_timers_even_when_stop_trace_fails(
+        self, tmp_path, monkeypatch
+    ):
+        import jax
+
+        s = ProfileSession(enabled=True, profile_dir=tmp_path / "p")
+        with s.phase("rollout"):
+            pass
+        s._tracing = True  # as if on_iteration had started a trace
+
+        def boom():
+            raise RuntimeError("profiler wedged")
+
+        monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+        s.close()  # must not raise
+        assert not s._tracing
+        data = json.loads(
+            (tmp_path / "p" / "phase_timers.json").read_text()
+        )
+        assert data["rollout"]["count"] == 1
+
+    def test_invalid_trace_window_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="trace_stop"):
+            ProfileSession(
+                enabled=True, profile_dir=tmp_path / "p",
+                trace_start=3, trace_stop=3,
+            )
+
+    def test_phase_records_spans_on_attached_tracer(self, tmp_path):
+        from alphatriangle_tpu.telemetry import SpanTracer
+
+        tracer = SpanTracer()
+        s = ProfileSession(
+            enabled=False, profile_dir=tmp_path / "p", tracer=tracer
+        )
+        with s.phase("rollout"):
+            pass
+        with s.phase("rollout"):
+            pass
+        # Both surfaces see the phase: whole-run mean AND per-occurrence
+        # spans (disabled device profiling doesn't gate the tracer).
+        assert s.timers.summary()["rollout"]["count"] == 2
+        assert tracer.summary()["rollout"]["count"] == 2
+
 
 class TestXplaneSummary:
     def test_summarize_real_trace(self, tmp_path, capsys):

@@ -29,11 +29,35 @@ class TestCollector:
         assert col.latest("score") == 7.0
         col.close()
 
-    def test_nonfinite_dropped(self, tmp_path):
+    def test_nonfinite_dropped_counted_not_silent(self, tmp_path, caplog):
+        import logging
+
         col = StatsCollector(log_dir=tmp_path / "tb")
-        col.log_scalar("x", float("nan"))
-        col.log_scalar("x", float("inf"))
-        assert col.process_and_log(0) == {}
+        with caplog.at_level(logging.WARNING):
+            col.log_scalar("x", float("nan"))
+            col.log_scalar("x", float("inf"))
+            col.log_scalar("y", float("nan"))
+        # Dropped from aggregation, but surfaced: cumulative count as a
+        # scalar on each tick, per-name counts introspectable, and one
+        # warning per metric name (not one per value, not silence).
+        means = col.process_and_log(0)
+        assert means == {"Stats/nonfinite_dropped": 3.0}
+        assert col.nonfinite_dropped() == {"x": 2, "y": 1}
+        warnings = [
+            r for r in caplog.records if "Non-finite" in r.getMessage()
+        ]
+        assert len(warnings) == 2  # once for x, once for y
+        # Counter is cumulative and keeps appearing on later ticks.
+        col.log_scalar("z", 1.0, step=1)
+        means = col.process_and_log(1)
+        assert means["Stats/nonfinite_dropped"] == 3.0
+        assert means["z"] == 1.0
+        col.close()
+
+    def test_no_drops_no_counter_metric(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.log_scalar("x", 1.0)
+        assert "Stats/nonfinite_dropped" not in col.process_and_log(0)
         col.close()
 
     def test_tensorboard_files_written(self, tmp_path):

@@ -9,6 +9,7 @@ from alphatriangle_tpu import cli
 from alphatriangle_tpu.stats.watch import (
     WatchState,
     find_latest_run_dir,
+    health_line,
     render_frame,
     tail_live_metrics,
 )
@@ -189,6 +190,73 @@ class TestCliWatch:
     def test_no_runs_errors(self, tmp_path, capsys):
         rc = cli.main(["watch", "--root-dir", str(tmp_path), "--once"])
         assert rc == 1
+
+
+class TestHealthLine:
+    def test_live_heartbeat(self):
+        hb = {
+            "time": 1000.0,
+            "learner_step": 42,
+            "watchdog_deadline_s": 300.0,
+        }
+        line = health_line(hb, now=1010.0)
+        assert "live" in line and "step 42" in line and "10s" in line
+
+    def test_stalled_when_heartbeat_ages_out(self):
+        hb = {"time": 1000.0, "watchdog_deadline_s": 100.0}
+        line = health_line(hb, now=1350.0)
+        assert "STALLED (no heartbeat for 350s)" in line
+
+    def test_stalled_when_watchdog_flagged(self):
+        hb = {
+            "time": 1000.0,
+            "stalled": True,
+            "watchdog_deadline_s": 300.0,
+        }
+        line = health_line(hb, now=1010.0)
+        assert "STALLED" in line and "watchdog" in line
+
+    def test_no_heartbeat_no_line(self):
+        assert health_line(None) is None
+        assert health_line({"not": "a heartbeat"}) is None
+        # Frame without a heartbeat stays at its pre-telemetry shape.
+        frame = render_frame(WatchState(), "r")
+        assert "health" not in frame
+
+    def test_frame_includes_stall_verdict(self):
+        s = WatchState()
+        s.fold_line(tick(5, time.time(), **{"Buffer/Size": 1.0}))
+        hb = {"time": time.time() - 9999.0, "watchdog_deadline_s": 300.0}
+        frame = render_frame(s, "r", health=hb)
+        assert "STALLED (no heartbeat for" in frame
+
+    def test_cli_watch_renders_stall(self, tmp_path, capsys):
+        run = tmp_path / "AlphaTriangleTPU" / "runs" / "h_run"
+        run.mkdir(parents=True)
+        (run / "live_metrics.jsonl").write_text(
+            tick(7, time.time(), **{"Buffer/Size": 11.0}) + "\n"
+        )
+        (run / "health.json").write_text(
+            json.dumps(
+                {
+                    "time": time.time() - 5000.0,
+                    "learner_step": 7,
+                    "watchdog_deadline_s": 300.0,
+                }
+            )
+        )
+        rc = cli.main(
+            [
+                "watch",
+                "--run-name",
+                "h_run",
+                "--root-dir",
+                str(tmp_path),
+                "--once",
+            ]
+        )
+        assert rc == 0
+        assert "STALLED (no heartbeat for" in capsys.readouterr().out
 
 
 class TestRateRobustness:
