@@ -1,0 +1,236 @@
+"""Shared bench configuration resolution: one source of truth for the
+exact shapes `bench.py` measures and `cli warm` precompiles.
+
+The compile-latency subsystem (compile_cache.py) only pays off when the
+warmer lowers PRECISELY the programs the bench will dispatch — same
+configs, same batch/chunk/K shapes, same dtypes. Duplicating the bench's
+config-building logic in the warm path would drift; both now call
+`resolve_bench_plan`, which honors the same env knobs (BENCH_CONFIG,
+BENCH_RECIPE, BENCH_GATHER, BENCH_WAVE, BENCH_FAST_SIMS,
+BENCH_FULL_PROB, BENCH_BATCH) and the same cpu/smoke clamps.
+"""
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BenchPlan:
+    """Everything run_bench / warm need about one measurement config."""
+
+    env: object
+    model: object
+    mcts: object
+    train: object
+    scale: str
+    sims: int
+    sp_batch: int
+    chunk: int
+    lbatch: int
+    description: str = ""
+    # Secondary-section shapes, derived the way run_bench derives them.
+    fused_k: int = 4
+    overlap_k: int = 4
+    device_replay: bool = False
+    extras: dict = field(default_factory=dict)
+
+
+def resolve_bench_plan(
+    smoke: bool, backend: str, environ=None
+) -> BenchPlan:
+    """Build the measurement configs for this (backend, env) pair.
+
+    Raises SystemExit on a mislabeled-measurement request (unknown
+    BENCH_RECIPE), exactly like the bench always has.
+    """
+    env = os.environ if environ is None else environ
+    from .config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+
+    preset = env.get("BENCH_CONFIG")
+    if preset:
+        # One of the five BASELINE configs (config/presets.py).
+        from .config import baseline_preset
+
+        bundle = baseline_preset(int(preset), run_name="bench")
+        env_cfg, model_cfg = bundle["env"], bundle["model"]
+        # Honor the A/B knobs in the preset path too (a silently
+        # ignored knob would mislabel the measurement).
+        preset_mcts_updates: dict = {
+            "descent_gather": env.get("BENCH_GATHER", "einsum")
+        }
+        if env.get("BENCH_WAVE"):
+            preset_mcts_updates["mcts_batch_size"] = int(env["BENCH_WAVE"])
+        if env.get("BENCH_FAST_SIMS"):
+            preset_mcts_updates["fast_simulations"] = int(
+                env["BENCH_FAST_SIMS"]
+            )
+            preset_mcts_updates["full_search_prob"] = float(
+                env.get("BENCH_FULL_PROB", "0.25")
+            )
+        preset_recipe = env.get("BENCH_RECIPE")
+        if preset_recipe not in (None, "", "puct", "gumbel_pcr"):
+            raise SystemExit(
+                f"Unknown BENCH_RECIPE={preset_recipe!r} "
+                "(valid: puct, gumbel_pcr) — refusing to run a "
+                "mislabeled measurement."
+            )
+        if preset_recipe == "puct":
+            preset_mcts_updates["root_selection"] = "puct"
+            preset_mcts_updates.setdefault("fast_simulations", None)
+        elif preset_recipe == "gumbel_pcr":
+            preset_mcts_updates["root_selection"] = "gumbel"
+            preset_mcts_updates.setdefault(
+                "fast_simulations",
+                max(1, bundle["mcts"].max_simulations // 4),
+            )
+            preset_mcts_updates.setdefault("full_search_prob", 0.25)
+        mcts_cfg = bundle["mcts"].model_copy(update=preset_mcts_updates)
+        train_updates = {
+            "BUFFER_CAPACITY": 10_000,
+            "MIN_BUFFER_SIZE_TO_TRAIN": 1_000,
+            "MAX_TRAINING_STEPS": 1_000,
+        }
+        if backend == "cpu" or smoke:
+            # Neither a CPU nor a smoke run can push the preset's full
+            # lane count; keep the net/search knobs, shrink lanes.
+            cap = 16 if smoke else 64
+            train_updates["SELF_PLAY_BATCH_SIZE"] = min(
+                cap, bundle["train"].SELF_PLAY_BATCH_SIZE
+            )
+            train_updates["ROLLOUT_CHUNK_MOVES"] = 4
+        if env.get("BENCH_BATCH"):
+            # Lane-count A/B (see the non-preset path note). Still
+            # bounded by the cpu/smoke clamp above: a flagship lane
+            # count on a CPU fallback would blow the whole budget on
+            # one chunk.
+            requested = int(env["BENCH_BATCH"])
+            if backend == "cpu" or smoke:
+                requested = min(
+                    requested, train_updates["SELF_PLAY_BATCH_SIZE"]
+                )
+            train_updates["SELF_PLAY_BATCH_SIZE"] = requested
+        if backend == "cpu":
+            model_cfg = model_cfg.model_copy(
+                update={"COMPUTE_DTYPE": "float32"}
+            )
+        # Rebuild via the constructor so validation + schedule-length
+        # derivation run against the bench horizon.
+        base_kw = bundle["train"].model_dump()
+        base_kw.pop("LR_SCHEDULER_T_MAX", None)
+        base_kw.pop("PER_BETA_ANNEAL_STEPS", None)
+        base_kw.update(train_updates)
+        train_cfg = TrainConfig(**base_kw)
+        scale = f"baseline_config_{preset}"
+        sims = mcts_cfg.max_simulations
+        sp_batch = train_cfg.SELF_PLAY_BATCH_SIZE
+        chunk = train_cfg.ROLLOUT_CHUNK_MOVES
+        lbatch = train_cfg.BATCH_SIZE
+        description = bundle["description"]
+    else:
+        # Three scales: smoke (sanity), cpu (a CPU can't push the
+        # flagship load — one flagship chunk is ~30 min of CPU leaf
+        # evals — so the fallback measures a reduced but honest
+        # config), flagship (TPU).
+        if smoke:
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "smoke", 8, 4, 16, 4, 32,
+            )
+        elif backend == "cpu":
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "cpu", 16, 8, 64, 4, 128,
+            )
+        else:
+            scale, sims, depth, sp_batch, chunk, lbatch = (
+                "flagship", 64, 8, 512, 16, 256,
+            )
+        env_cfg = EnvConfig()
+        model_cfg = ModelConfig(
+            OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+            COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+        )
+        mcts_kw: dict = {}
+        if env.get("BENCH_FAST_SIMS"):
+            # Playout cap randomization A/B (KataGo; docs in
+            # config/mcts_config.py): BENCH_FAST_SIMS=16 [BENCH_FULL_PROB=0.25]
+            mcts_kw["fast_simulations"] = int(env["BENCH_FAST_SIMS"])
+            mcts_kw["full_search_prob"] = float(
+                env.get("BENCH_FULL_PROB", "0.25")
+            )
+        if env.get("BENCH_WAVE"):
+            # Wave-size A/B: simulations evaluated in parallel per tree
+            # (the MXU batch per eval is SELF_PLAY_BATCH_SIZE x wave).
+            mcts_kw["mcts_batch_size"] = int(env["BENCH_WAVE"])
+        if env.get("BENCH_BATCH"):
+            # Lane-count A/B: more lockstep games per dispatch = bigger
+            # MXU batches per wave eval (flagship B=512 measured 1.4%
+            # self-play MFU — lane count is the direct lever on it).
+            # On cpu/smoke the scale's own lane count is the ceiling: a
+            # flagship lane count on a CPU fallback would blow the whole
+            # budget on one chunk.
+            requested = int(env["BENCH_BATCH"])
+            if scale in ("cpu", "smoke"):
+                requested = min(requested, sp_batch)
+            sp_batch = requested
+        recipe = env.get(
+            "BENCH_RECIPE", "gumbel_pcr" if scale == "flagship" else "puct"
+        )
+        if recipe not in ("puct", "gumbel_pcr"):
+            raise SystemExit(
+                f"Unknown BENCH_RECIPE={recipe!r} (valid: puct, "
+                "gumbel_pcr) — refusing to run a mislabeled measurement."
+            )
+        if recipe == "gumbel_pcr":
+            # The flagship training recipe: Gumbel root + playout cap
+            # randomization — the measured-best learning arm (+11%
+            # converged eval at <1/2 search cost, BASELINE.md A/Bs).
+            # BENCH_RECIPE=puct measures the reference-parity search.
+            mcts_kw["root_selection"] = "gumbel"
+            mcts_kw.setdefault("fast_simulations", max(1, sims // 4))
+            mcts_kw.setdefault("full_search_prob", 0.25)
+        mcts_cfg = AlphaTriangleMCTSConfig(
+            max_simulations=sims,
+            max_depth=depth,
+            # A/B knob for the descent row-gather lowering
+            # (ops/gather_rows.py).
+            descent_gather=env.get("BENCH_GATHER", "einsum"),
+            **mcts_kw,
+        )
+        train_cfg = TrainConfig(
+            SELF_PLAY_BATCH_SIZE=sp_batch,
+            ROLLOUT_CHUNK_MOVES=chunk,
+            BATCH_SIZE=lbatch,
+            BUFFER_CAPACITY=10_000,
+            MIN_BUFFER_SIZE_TO_TRAIN=1_000,
+            MAX_TRAINING_STEPS=1_000,
+            RUN_NAME="bench",
+        )
+        description = f"{scale} scale"
+
+    # Secondary-section shapes, exactly as run_bench derives them:
+    # fused groups keep K small where the scan unrolls (cpu/smoke), the
+    # overlapped section amortizes the producer interleave with K=64 on
+    # accelerators, and device-resident replay only exists off-CPU.
+    fused_k = 4 if (smoke or backend == "cpu") else 16
+    overlap_k = fused_k if (smoke or backend == "cpu") else 64
+    device_replay = backend != "cpu" and not smoke
+    return BenchPlan(
+        env=env_cfg,
+        model=model_cfg,
+        mcts=mcts_cfg,
+        train=train_cfg,
+        scale=scale,
+        sims=sims,
+        sp_batch=sp_batch,
+        chunk=chunk,
+        lbatch=lbatch,
+        description=description,
+        fused_k=fused_k,
+        overlap_k=overlap_k,
+        device_replay=device_replay,
+    )

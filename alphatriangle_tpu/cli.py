@@ -895,6 +895,55 @@ def cmd_play(args: argparse.Namespace) -> int:
         print(f"reward {reward:+.1f}")
 
 
+def cmd_warm(args: argparse.Namespace) -> int:
+    """AOT-precompile the hot bench/training programs for a preset so a
+    later bench/run starts measuring in seconds instead of burning its
+    healthy chip window on first-chunk compiles (docs/COMPILE_CACHE.md).
+
+    `benchmarks/tpu_watch.sh` runs this after every successful chip
+    probe; by the time a window opens the persistent + AOT executable
+    caches already hold the sweep's exact shapes. Exit 0 when every
+    requested program is AOT-ready, 1 when any fell back or failed.
+    """
+    import json as _json
+    import os as _os
+
+    from .utils.helpers import enforce_platform
+
+    # `warm cpu` pins the CPU backend (warming the bench's CPU-fallback
+    # shapes without waking a possibly-wedged accelerator).
+    device = args.device or ("cpu" if args.target == "cpu" else "auto")
+    enforce_platform(device)
+
+    import jax
+
+    from .bench_config import resolve_bench_plan
+    from .utils.helpers import enable_persistent_compilation_cache
+    from .warm import warm_bench_programs
+
+    backend = jax.default_backend()
+    # Backend resolved: gate the XLA persistent cache correctly (the
+    # AOT executable cache works on every backend regardless).
+    enable_persistent_compilation_cache(backend=backend)
+
+    environ = dict(_os.environ)
+    smoke = args.target == "smoke" or environ.get("BENCH_SMOKE") == "1"
+    if args.target and args.target.isdigit():
+        environ["BENCH_CONFIG"] = args.target
+    # target auto/cpu/smoke: honor ambient BENCH_* knobs as bench does.
+    plan = resolve_bench_plan(smoke, backend, environ=environ)
+    programs = set(args.programs.split(",")) if args.programs else None
+    report = warm_bench_programs(
+        plan,
+        jobs=args.jobs,
+        programs=programs,
+        progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+    )
+    print(_json.dumps(report))
+    ok = all(r["status"] == "aot" for r in report["programs"])
+    return 0 if (ok and report["programs"]) else 1
+
+
 def cmd_tune(args: argparse.Namespace) -> int:
     """On-hardware self-play shape autotuner.
 
@@ -1094,6 +1143,40 @@ def main(argv: list[str] | None = None) -> int:
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
 
+    warm = sub.add_parser(
+        "warm",
+        help="AOT-precompile the hot bench/training programs (rollout "
+        "chunk, learner step, fused groups) into the executable cache "
+        "so the next bench/run skips first-dispatch compiles.",
+    )
+    warm.add_argument(
+        "target",
+        nargs="?",
+        default="auto",
+        choices=["auto", "smoke", "cpu", "1", "2", "3", "4", "5"],
+        help="What to warm: 'auto' = the bench scale for this backend "
+        "(honors ambient BENCH_* knobs), 'smoke'/'cpu' = the reduced "
+        "scales, 1..5 = a BASELINE preset (config/presets.py).",
+    )
+    warm.add_argument(
+        "--jobs",
+        type=int,
+        default=4,
+        metavar="N",
+        help="Programs compiled in parallel threads (XLA releases the "
+        "GIL during compilation).",
+    )
+    warm.add_argument(
+        "--programs",
+        default=None,
+        metavar="SUBSTR[,SUBSTR...]",
+        help="Only warm programs whose name contains one of these "
+        "substrings (e.g. 'self_play,learner_step').",
+    )
+    warm.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+
     tune = sub.add_parser(
         "tune",
         help="Sweep self-play batch/chunk shapes on this hardware and "
@@ -1138,6 +1221,7 @@ def main(argv: list[str] | None = None) -> int:
         "eval": cmd_eval,
         "play": cmd_play,
         "tune": cmd_tune,
+        "warm": cmd_warm,
     }
     return handlers[args.command](args)
 

@@ -1,0 +1,454 @@
+"""AOT executable cache: serialize compiled XLA programs across processes.
+
+Five rounds of benchmarking produced zero driver-captured TPU numbers
+because the first rollout-chunk compile (34.7s CPU / 58.8s on the
+tunneled TPU, BENCH_r05.json) burned every short healthy chip window
+before the first metric landed. The XLA persistent compilation cache
+(utils/helpers.py:enable_persistent_compilation_cache) already removes
+*re*-compiles on accelerator backends, but (a) it is disabled on CPU
+(AOT reload SIGILL risk at the XLA layer), (b) it still pays tracing +
+lowering + cache lookup inside the measurement window, and (c) nothing
+fills it ahead of a window. This module closes all three gaps,
+Podracer-style (arXiv:2104.06272 treats program build/launch latency as
+a first-class amortized cost):
+
+- `CompileCache.wrap(name, jit_fn)` returns a `CachedProgram` that, on
+  first dispatch of each distinct input signature, either DESERIALIZES
+  a previously saved executable (hit: milliseconds instead of a full
+  compile) or compiles fresh and serializes the result for the next
+  process (miss). Executables ride `jax.experimental.
+  serialize_executable` and live beside the XLA persistent cache.
+- Keys are (jax version, backend, device kinds + topology, a source
+  digest of this package, program name + config digest, input
+  avals/shardings) — see `docs/COMPILE_CACHE.md` for the invalidation
+  rules. A key mismatch is never an error: it just falls back to a
+  fresh `lower().compile()`.
+- `warm.py` + `cli warm` enumerate the hot bench/training programs for
+  a preset and push them through this cache ahead of time, so the chip
+  watcher can make any future healthy window start measuring in
+  seconds.
+
+Every load/compile/serialize is recorded as a `compile/<name>` span on
+the attached `SpanTracer` (telemetry/tracer.py), so compile cost shows
+up in trace.json next to rollout/learner spans; `stats()` feeds the
+bench JSON's `compile_cache: {hits, misses}` block.
+
+Degradation contract: any failure (unpicklable executable, corrupt
+file, host feature mismatch on reload, an exotic backend without
+serialization support) logs once and falls back to the plain jitted
+call — the cache can only ever add speed, never break a run.
+"""
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+import time
+from contextlib import nullcontext
+from pathlib import Path
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def _exc_brief(exc: BaseException, limit: int = 160) -> str:
+    """Exception text bounded for logs (XLA reload errors embed the
+    full missing-symbol list — thousands of characters of noise)."""
+    text = f"{type(exc).__name__}: {exc}"
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+# Sentinel stored per signature when AOT execution is not viable for
+# those inputs; the program permanently delegates to the jitted fall
+# back for that signature (never retries a failing executable).
+_FALLBACK = object()
+
+
+def default_cache_dir() -> str:
+    """AOT executables live in an `aot/` subdir beside the XLA
+    persistent cache so one directory knob (JAX_COMPILATION_CACHE_DIR)
+    moves both."""
+    root = (
+        os.environ.get("ALPHATRIANGLE_AOT_CACHE_DIR")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or "/tmp/alphatriangle_tpu_jax_cache"
+    )
+    return os.path.join(root, "aot")
+
+
+def _package_source_digest() -> str:
+    """Digest of every .py file in this package: executables are only
+    reused by the exact code that produced them. The shape signature
+    alone cannot see a changed scan body or loss function — reusing a
+    stale executable would silently compute the wrong thing, the one
+    failure mode a cache must not have."""
+    pkg = Path(__file__).parent
+    h = hashlib.sha256()
+    for path in sorted(pkg.rglob("*.py")):
+        h.update(str(path.relative_to(pkg)).encode())
+        try:
+            h.update(path.read_bytes())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()[:16]
+
+
+_source_digest_cache: str | None = None
+
+
+def _source_digest() -> str:
+    global _source_digest_cache
+    if _source_digest_cache is None:
+        _source_digest_cache = _package_source_digest()
+    return _source_digest_cache
+
+
+def config_digest(*configs) -> str:
+    """Fingerprint config objects that shape a program but are invisible
+    in its input avals (MCTS sim counts, loss weights, optimizer type).
+    Pydantic models dump to canonical JSON; anything else reprs.
+    RUN_NAME is excluded — it can never affect a compiled program, and
+    keeping it would make every differently-named run a cache miss."""
+    h = hashlib.sha256()
+    for cfg in configs:
+        if cfg is None:
+            h.update(b"none")
+            continue
+        dump = getattr(cfg, "model_dump", None)
+        if callable(dump):
+            d = dump()
+            d.pop("RUN_NAME", None)
+            h.update(repr(sorted(d.items())).encode())
+        else:
+            h.update(repr(cfg).encode())
+    return h.hexdigest()[:12]
+
+
+def _describe_leaf(x) -> str:
+    """Stable aval + sharding description of one input leaf.
+
+    Mesh (Named) shardings genuinely change the lowered program (GSPMD
+    partitioning) and are part of the key; single-device placement vs
+    an uncommitted host array does not (both lower to the same
+    default-device program), so everything else canonicalizes to "-"
+    — this is what lets `cli warm`'s lowering match the bench process's
+    real dispatch arguments.
+    """
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = getattr(getattr(x, "dtype", None), "name", str(getattr(x, "dtype", type(x).__name__)))
+    sh = getattr(x, "sharding", None)
+    if sh is not None and type(sh).__name__ == "NamedSharding":
+        mesh_desc = tuple((str(k), int(v)) for k, v in sh.mesh.shape.items())
+        sh_desc = f"NS{mesh_desc}{sh.spec}"
+    else:
+        sh_desc = "-"
+    return f"{dtype}{list(shape)}@{sh_desc}"
+
+
+class CompileCache:
+    """Process-wide registry of AOT-cached programs (see module doc)."""
+
+    def __init__(
+        self, cache_dir: str | None = None, enabled: bool | None = None
+    ) -> None:
+        if enabled is None:
+            enabled = os.environ.get("ALPHATRIANGLE_NO_COMPILE_CACHE") != "1"
+        self.cache_dir = Path(cache_dir or default_cache_dir())
+        self.enabled = enabled
+        self.tracer = None  # optional telemetry SpanTracer
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.deserialize_errors = 0
+        self.serialize_errors = 0
+        self.exec_errors = 0
+        # name -> {"event": hit|miss|..., "seconds": float}
+        self.events: list[dict] = []
+
+    # --- wiring -----------------------------------------------------------
+
+    def set_tracer(self, tracer) -> None:
+        """Attach a telemetry SpanTracer: every load/compile/serialize
+        becomes a `compile/<program>` span in the run's trace.json."""
+        self.tracer = tracer
+
+    def _span(self, name: str, **args):
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **args)
+
+    def wrap(self, name: str, jit_fn, extra: str = "") -> "CachedProgram":
+        """Wrap a jitted function in an AOT-caching dispatcher.
+
+        `extra` carries a digest of everything that shapes the program
+        but is invisible in its input avals (use `config_digest`)."""
+        return CachedProgram(self, name, jit_fn, extra=extra)
+
+    # --- keying -----------------------------------------------------------
+
+    def signature(self, name: str, args: tuple, extra: str = "") -> str:
+        """Cross-process cache key for one (program, inputs) pair."""
+        backend = jax.default_backend()
+        devices = jax.devices()
+        parts = [
+            jax.__version__,
+            backend,
+            ",".join(
+                sorted({str(getattr(d, "device_kind", d.platform)) for d in devices})
+            ),
+            f"d{len(devices)}p{jax.process_count()}",
+            _source_digest(),
+            name,
+            extra,
+            str(jax.tree_util.tree_structure(args)),
+        ]
+        parts.extend(
+            _describe_leaf(leaf) for leaf in jax.tree_util.tree_leaves(args)
+        )
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()[:20]
+
+    def _path(self, name: str, key: str) -> Path:
+        safe = name.replace("/", "_").replace(" ", "_")
+        return self.cache_dir / f"{safe}-{key}.jaxexe"
+
+    # --- load / compile / serialize ---------------------------------------
+
+    def load_or_compile(self, name: str, key: str, jit_fn, args):
+        """Deserialize a cached executable for `key`, or compile fresh
+        (serializing the result). Returns a `jax.stages.Compiled`, or
+        `_FALLBACK` when neither path is viable."""
+        path = self._path(name, key)
+        if path.exists():
+            t0 = time.time()
+            try:
+                with self._span(f"compile/{name}", event="deserialize"):
+                    from jax.experimental.serialize_executable import (
+                        deserialize_and_load,
+                    )
+
+                    with path.open("rb") as fh:
+                        record = pickle.load(fh)
+                    compiled = deserialize_and_load(
+                        record["payload"], record["in_tree"], record["out_tree"]
+                    )
+                dt = time.time() - t0
+                self._note("hit", name, dt)
+                logger.info(
+                    "compile_cache: %s HIT (%s, deserialized in %.2fs)",
+                    name,
+                    path.name,
+                    dt,
+                )
+                return compiled
+            except Exception as exc:
+                # Corrupt file, jaxlib mismatch, host feature check
+                # failure on reload — treat as a miss and recompile.
+                self.deserialize_errors += 1
+                logger.warning(
+                    "compile_cache: %s deserialize failed (%s); "
+                    "recompiling fresh.",
+                    name,
+                    _exc_brief(exc),
+                )
+        t0 = time.time()
+        try:
+            with self._span(f"compile/{name}", event="compile"):
+                compiled = jit_fn.lower(*args).compile()
+        except Exception as exc:
+            # e.g. a transform jit cannot lower for these args; the
+            # plain call path may still work — let it own the error.
+            logger.warning(
+                "compile_cache: %s AOT lower/compile failed (%s); "
+                "falling back to the jitted call.",
+                name,
+                _exc_brief(exc),
+            )
+            self.exec_errors += 1
+            return _FALLBACK
+        dt = time.time() - t0
+        self._note("miss", name, dt)
+        logger.info("compile_cache: %s MISS (compiled in %.2fs)", name, dt)
+        self._serialize(name, path, compiled)
+        return compiled
+
+    def _serialize(self, name: str, path: Path, compiled) -> None:
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        try:
+            with self._span(f"compile/{name}", event="serialize"):
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                    serialize,
+                )
+
+                payload, in_tree, out_tree = serialize(compiled)
+                record = {
+                    "payload": payload,
+                    "in_tree": in_tree,
+                    "out_tree": out_tree,
+                    "meta": {
+                        "name": name,
+                        "jax": jax.__version__,
+                        "backend": jax.default_backend(),
+                        "created": time.time(),
+                    },
+                }
+                path.parent.mkdir(parents=True, exist_ok=True)
+                with tmp.open("wb") as fh:
+                    pickle.dump(record, fh)
+                # VALIDATE before publishing: an executable that
+                # compile() itself loaded from the XLA persistent cache
+                # serializes to a truncated payload on XLA:CPU (the
+                # object code is absent; reload dies with "Symbols not
+                # found"). A broken artifact would turn every future
+                # warm start into a deserialize-error + recompile — so
+                # prove the round trip here, where the cost is off any
+                # measurement window, and publish only what reloads.
+                with tmp.open("rb") as fh:
+                    check = pickle.load(fh)
+                deserialize_and_load(
+                    check["payload"], check["in_tree"], check["out_tree"]
+                )
+                tmp.replace(path)  # atomic: readers never see a torn file
+        except Exception as exc:
+            self.serialize_errors += 1
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            logger.warning(
+                "compile_cache: %s not serialized (%s) — this process "
+                "keeps its in-memory executable; the next cold process "
+                "recompiles (or reuses the XLA persistent cache).",
+                name,
+                _exc_brief(exc),
+            )
+
+    def _note(self, event: str, name: str, seconds: float) -> None:
+        with self._lock:
+            if event == "hit":
+                self.hits += 1
+            else:
+                self.misses += 1
+            self.events.append(
+                {"event": event, "program": name, "seconds": round(seconds, 3)}
+            )
+
+    # --- reporting --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The bench JSON `compile_cache` block."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "dir": str(self.cache_dir),
+                "hits": self.hits,
+                "misses": self.misses,
+                "deserialize_errors": self.deserialize_errors,
+                "serialize_errors": self.serialize_errors,
+                "exec_errors": self.exec_errors,
+                "events": list(self.events),
+            }
+
+
+class CachedProgram:
+    """Callable wrapper over one jitted function: per-input-signature
+    AOT executables with a jitted fallback.
+
+    Drop-in for the jitted function it wraps (bit-identical outputs —
+    it runs the same lowered program), plus:
+    - `warm(*args)`: populate (deserialize or compile+serialize) the
+      executable for these argument avals WITHOUT executing — the AOT
+      precompilation entry point (`cli warm`).
+    - multi-signature: a program called with several distinct shapes
+      (e.g. the trainer's fused-from program across K values) caches an
+      executable per signature, exactly like jit's own cache.
+    """
+
+    def __init__(
+        self, cache: CompileCache, name: str, jit_fn, extra: str = ""
+    ) -> None:
+        self._cache = cache
+        self.name = name
+        self._jit_fn = jit_fn
+        self._extra = extra
+        self._execs: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _executable_for(self, args):
+        key = self._cache.signature(self.name, args, self._extra)
+        exe = self._execs.get(key)
+        if exe is None:
+            with self._lock:
+                exe = self._execs.get(key)
+                if exe is None:
+                    exe = self._cache.load_or_compile(
+                        self.name, key, self._jit_fn, args
+                    )
+                    self._execs[key] = exe
+        return key, exe
+
+    def warm(self, *args) -> bool:
+        """Ensure an executable exists for these argument avals (no
+        execution, no donation). True when an AOT executable is ready,
+        False when this program fell back to plain jit."""
+        if not self._cache.enabled:
+            return False
+        _, exe = self._executable_for(args)
+        return exe is not _FALLBACK
+
+    def __call__(self, *args):
+        if not self._cache.enabled:
+            return self._jit_fn(*args)
+        key, exe = self._executable_for(args)
+        if exe is _FALLBACK:
+            return self._jit_fn(*args)
+        try:
+            return exe(*args)
+        except (TypeError, ValueError) as exc:
+            # Input validation rejected the call BEFORE execution (so
+            # no buffer was donated): e.g. a weak-typed scalar the jit
+            # path would have accepted. Never retry this signature.
+            self._cache.exec_errors += 1
+            logger.warning(
+                "compile_cache: %s AOT call rejected (%s: %s); using "
+                "the jitted path for this signature.",
+                self.name,
+                type(exc).__name__,
+                exc,
+            )
+            self._execs[key] = _FALLBACK
+            return self._jit_fn(*args)
+
+
+# --- process-wide cache ----------------------------------------------------
+
+_global_cache: CompileCache | None = None
+_global_lock = threading.Lock()
+
+
+def get_compile_cache() -> CompileCache:
+    """The process-wide cache every engine/trainer wraps through.
+
+    Multi-process runs disable AOT caching (deserializing an executable
+    that spans non-addressable devices is not supported); the XLA
+    persistent cache still covers those.
+    """
+    global _global_cache
+    with _global_lock:
+        if _global_cache is None:
+            cache = CompileCache()
+            if jax.process_count() > 1:
+                cache.enabled = False
+            _global_cache = cache
+        return _global_cache
+
+
+def reset_compile_cache(
+    cache_dir: str | None = None, enabled: bool | None = None
+) -> CompileCache:
+    """Replace the process-wide cache (tests; fresh stats windows)."""
+    global _global_cache
+    with _global_lock:
+        _global_cache = CompileCache(cache_dir=cache_dir, enabled=enabled)
+        return _global_cache

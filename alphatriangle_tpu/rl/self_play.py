@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..compile_cache import config_digest, get_compile_cache
 from ..config.mcts_config import MCTSConfig
 from ..config.train_config import TrainConfig
 from ..env.engine import EnvState, TriangleEnv
@@ -222,10 +223,29 @@ class SelfPlayEngine:
                 )
             self._chunk_fn = share_compiled._chunk_fn
         else:
+            # Each distinct chunk length wraps its jitted program in
+            # the AOT compile cache: a warm cache (cli warm, a prior
+            # bench/run with these shapes) deserializes the serialized
+            # executable instead of paying the full first-chunk compile
+            # — the heaviest program in the codebase, and the one that
+            # burned every short healthy chip window in rounds 1-5.
+            # The config digest keys everything that shapes the program
+            # but is invisible in its input avals (sim counts, n-step,
+            # reward params, net architecture).
+            chunk_extra = config_digest(
+                self.mcts_config,
+                self.config,
+                extractor.model_config,
+                env.cfg,
+            ) + f"|lanes{self.data_axes if mesh is not None else ()}"
             self._chunk_fn = functools.lru_cache(maxsize=None)(
-                lambda num_moves: jax.jit(
-                    functools.partial(self._chunk, num_moves),
-                    donate_argnums=(1,),
+                lambda num_moves: get_compile_cache().wrap(
+                    f"self_play_chunk/t{num_moves}",
+                    jax.jit(
+                        functools.partial(self._chunk, num_moves),
+                        donate_argnums=(1,),
+                    ),
+                    extra=chunk_extra,
                 )
             )
 
@@ -597,6 +617,23 @@ class SelfPlayEngine:
             )
             self._episodes_played += int(ending.sum())
             self._episodes_truncated += int(episode["truncated"][ending].sum())
+
+    def warm_chunk(self, num_moves: int | None = None) -> bool:
+        """AOT-precompile the rollout chunk program WITHOUT running it.
+
+        Lowers with the engine's real (variables, carry, version)
+        arguments — so the cache signature matches what `play_chunk`
+        will dispatch — and either deserializes a cached executable or
+        compiles + serializes one. Lowering never executes or donates;
+        the carry is untouched. Returns True when an AOT executable is
+        ready (`cli warm`, benchmarks/tpu_watch.sh)."""
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        version = self.net.weights_version
+        return self._chunk_fn(t).warm(
+            self._place_variables(self.net.variables, version),
+            self._carry,
+            jnp.int32(version),
+        )
 
     def play_move(self) -> None:
         """Advance every game by one move (single-move chunk)."""

@@ -146,6 +146,20 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
             self.storage, jnp.asarray(self._cursors, jnp.int32), blocks
         )
         counts = np.asarray(counts_dev)  # (dp,) — the one fetch
+        # Host-side slot reconstruction below assumes each shard wrote
+        # at most cap_local rows this ingest (slot uniqueness): a count
+        # above cap_local would mean the ring lapped itself WITHIN one
+        # scatter, making `cursor + arange(c) % cap_local` repeat slots
+        # — later writes would silently win and the SumTree priorities
+        # would attach to overwritten rows. The engine cannot produce
+        # it (a chunk's lanes-per-shard x (T + n) rows are sized well
+        # under capacity), so a trip here means a config/payload bug.
+        assert int(counts.max(initial=0)) <= self.cap_local, (
+            f"sharded ingest wrote {counts.max()} rows into a "
+            f"{self.cap_local}-slot shard in one scatter; per-shard "
+            "slot uniqueness is violated (shrink the chunk or grow "
+            "BUFFER_CAPACITY)"
+        )
         all_slots = []
         for k in range(self.dp):
             c = int(counts[k])

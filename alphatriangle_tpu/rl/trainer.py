@@ -29,6 +29,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compile_cache import config_digest, get_compile_cache
 from ..config.mesh_config import MeshConfig
 from ..config.train_config import TrainConfig
 from ..nn.network import NeuralNetwork
@@ -201,11 +202,24 @@ class Trainer:
             "weights": bshard,
             "policy_weight": bshard,
         }
-        self._step_fn = jax.jit(
-            self._train_step_impl,
-            in_shardings=(state_shard, batch_shards),
-            out_shardings=(state_shard, rep, bshard),
-            donate_argnums=(0,),
+        # Learner programs ride the AOT compile cache (compile_cache.py):
+        # a warm cache (cli warm / a prior same-shape run) deserializes
+        # the serialized executable instead of recompiling. The digest
+        # keys the program shapers invisible in input avals: optimizer/
+        # schedule/loss config, net architecture, board geometry.
+        cache = get_compile_cache()
+        self._cache_extra = config_digest(
+            train_config, nn.model_config, nn.env_config
+        ) + f"|att{int(getattr(nn.model, 'attention_fn', None) is not None)}"
+        self._step_fn = cache.wrap(
+            "learner_step",
+            jax.jit(
+                self._train_step_impl,
+                in_shardings=(state_shard, batch_shards),
+                out_shardings=(state_shard, rep, bshard),
+                donate_argnums=(0,),
+            ),
+            extra=self._cache_extra,
         )
         # Fused multi-step: batches stacked on a new leading K axis, dp
         # sharding on axis 1; one compiled program per distinct K.
@@ -213,19 +227,27 @@ class Trainer:
             self.mesh, P(None, self.dp_axis)
         )
         stacked_shards = {k: stacked_shard for k in batch_shards}
-        self._multi_step_fn = jax.jit(
-            self._train_steps_impl,
-            in_shardings=(state_shard, stacked_shards),
-            out_shardings=(state_shard, rep, stacked_shard),
-            donate_argnums=(0,),
+        self._multi_step_fn = cache.wrap(
+            "learner_fused_steps",
+            jax.jit(
+                self._train_steps_impl,
+                in_shardings=(state_shard, stacked_shards),
+                out_shardings=(state_shard, rep, stacked_shard),
+                donate_argnums=(0,),
+            ),
+            extra=self._cache_extra,
         )
         self._stacked_shard = stacked_shard
         # Device-buffer path (rl/device_buffer.py): batches are gathered
         # ON DEVICE from the replay ring by sampled indices — the fused
         # group's host->device traffic shrinks from K full batches to
-        # K*B int32 indices. One compiled program per distinct K.
-        self._from_fn = jax.jit(
-            self._train_steps_from_impl, donate_argnums=(0,)
+        # K*B int32 indices. One compiled program per distinct K (the
+        # cache wrapper keys executables per input signature, so the
+        # distinct-K programs each get their own AOT cache entry).
+        self._from_fn = cache.wrap(
+            "learner_fused_from_ring",
+            jax.jit(self._train_steps_from_impl, donate_argnums=(0,)),
+            extra=self._cache_extra,
         )
         # dp-sharded ring variant (rl/sharded_device_buffer.py): built
         # lazily on first use, cached per shard geometry — the program
@@ -401,7 +423,11 @@ class Trainer:
                 }
                 return self._train_steps_impl(state, stacked)
 
-            self._from_sharded_fns[key] = jax.jit(impl, donate_argnums=(0,))
+            self._from_sharded_fns[key] = get_compile_cache().wrap(
+                f"learner_fused_from_sharded_ring/s{stride}_{dp_axis}",
+                jax.jit(impl, donate_argnums=(0,)),
+                extra=self._cache_extra,
+            )
         return self._from_sharded_fns[key]
 
     def _train_steps_from_impl(self, state: TrainState, storage, idx, weights):
@@ -619,6 +645,62 @@ class Trainer:
             )
             results.append((m, td_host[i]))
         return results
+
+    # --- AOT warming (compile_cache.py; cli warm) -------------------------
+
+    def _zero_batch(self, n: int) -> DenseBatch:
+        """A dense batch of the training shapes, all zeros — enough to
+        lower the learner programs without touching a replay buffer."""
+        mc, ec = self.nn.model_config, self.nn.env_config
+        return {
+            "grid": np.zeros(
+                (n, mc.GRID_INPUT_CHANNELS, ec.ROWS, ec.COLS), np.float32
+            ),
+            "other_features": np.zeros(
+                (n, mc.OTHER_NN_INPUT_FEATURES_DIM), np.float32
+            ),
+            "policy_target": np.full(
+                (n, ec.action_dim), 1.0 / ec.action_dim, np.float32
+            ),
+            "value_target": np.zeros(n, np.float32),
+            "weights": np.ones(n, np.float32),
+            "policy_weight": np.ones(n, np.float32),
+        }
+
+    def warm_step(self, batch_size: int | None = None) -> bool:
+        """AOT-precompile the per-step learner program (no execution,
+        no state donation). True when an AOT executable is ready."""
+        b = batch_size or self.config.BATCH_SIZE
+        device_batch = shard_batch(
+            self.mesh, self._zero_batch(b), self.dp_axis
+        )
+        return self._step_fn.warm(self.state, device_batch)
+
+    def warm_steps(self, k: int, batch_size: int | None = None) -> bool:
+        """AOT-precompile the K-fused learner program (one entry per
+        distinct K, matching `train_steps`' per-K jit specialization)."""
+        b = batch_size or self.config.BATCH_SIZE
+        batch = self._zero_batch(b)
+        stacked_host = {key: np.stack([batch[key]] * k) for key in batch}
+        stacked = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._stacked_shard), stacked_host
+        )
+        return self._multi_step_fn.warm(self.state, stacked)
+
+    def warm_steps_from(
+        self, buffer, k: int, batch_size: int | None = None
+    ) -> bool:
+        """AOT-precompile the device-replay fused program against a
+        real ring's storage (shapes + shardings must match dispatch)."""
+        b = batch_size or self.config.BATCH_SIZE
+        idx = np.zeros((k, b), np.int32)
+        weights = np.ones((k, b), np.float32)
+        from_fn = (
+            self._get_from_sharded_fn(buffer)
+            if getattr(buffer, "is_sharded", False)
+            else self._from_fn
+        )
+        return from_fn.warm(self.state, buffer.storage, idx, weights)
 
     @property
     def global_step(self) -> int:

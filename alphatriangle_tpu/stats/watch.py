@@ -208,4 +208,14 @@ def find_latest_run_dir(runs_root: Path) -> "Path | None":
         return None
     if not candidates:
         return None
-    return max(candidates, key=lambda p: p.stat().st_mtime)
+
+    def mtime(p: Path) -> float:
+        # A run dir can be deleted (cleanup, tmpdir teardown) between
+        # the listing above and this stat; treat it as infinitely old
+        # instead of crashing `cli watch` at startup.
+        try:
+            return p.stat().st_mtime
+        except OSError:
+            return 0.0
+
+    return max(candidates, key=mtime)

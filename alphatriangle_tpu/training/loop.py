@@ -709,6 +709,11 @@ class TrainingLoop:
             primary.net,
             primary.mcts_config,
             primary.config,
+            # The primary may carry an explicit batch-size override
+            # (engine batch ≠ config SELF_PLAY_BATCH_SIZE); defaulting
+            # here would make share_compiled reject every respawn and
+            # burn all PRODUCER_MAX_RESTARTS on a config error.
+            batch_size=primary.batch_size,
             seed=self.cfg.RANDOM_SEED + 2000 + stream * 100 + attempt,
             share_compiled=primary,
             mesh=primary.mesh,

@@ -310,6 +310,14 @@ def setup_training_components(
         stats=stats,
         run_name=persistence_config.RUN_NAME,
     )
+    # Compile costs become `compile/<program>` spans in trace.json: the
+    # AOT executable cache (compile_cache.py) reports every hit
+    # (deserialize), miss (fresh compile) and serialize through the
+    # run's tracer, so cold-vs-warm start cost is visible next to the
+    # rollout/learner spans it delays.
+    from ..compile_cache import get_compile_cache
+
+    get_compile_cache().set_tracer(telemetry.tracer)
     all_configs = {
         "env": env_config,
         "model": model_config,

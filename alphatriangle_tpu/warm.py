@@ -1,0 +1,160 @@
+"""AOT precompilation of the hot bench/training programs (`cli warm`).
+
+The compile-latency story (docs/COMPILE_CACHE.md): every program the
+bench dispatches inside its measurement window — the self-play rollout
+chunk (with its embedded PUCT/Gumbel search), the learner step, the
+fused K-step group, the device-replay gather variant, the overlapped
+dispatch's bigger fused group — can be lowered and compiled BEFORE a
+healthy chip window opens, with the executables serialized through
+`compile_cache.CompileCache`. A later bench/training process with the
+same shapes then deserializes in milliseconds instead of compiling for
+the better part of a minute per program.
+
+`warm_bench_programs` builds the exact objects `bench.py` builds (via
+the shared `bench_config.resolve_bench_plan`) and pushes each hot
+program through `.warm()` in parallel threads — XLA compilation
+releases the GIL, so N programs compile concurrently, Podracer-style
+(arXiv:2104.06272 amortizes program build cost off the critical path).
+
+`benchmarks/tpu_watch.sh` runs `cli warm` after every successful chip
+probe: by the time a window is declared healthy and the sweep starts,
+the persistent + AOT caches already hold the sweep's programs.
+"""
+
+import concurrent.futures
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def warm_bench_programs(
+    plan,
+    jobs: int = 4,
+    programs: "set[str] | None" = None,
+    progress=None,
+) -> dict:
+    """AOT-compile the hot programs for one bench plan.
+
+    `programs`: optional name filter (substring match against the rows
+    below). `progress`: optional callable(str) for per-program lines.
+    Returns {"programs": [...rows...], "stats": CompileCache.stats(),
+    "seconds": total wall}.
+    """
+    import jax
+
+    from .compile_cache import get_compile_cache
+    from .env.engine import TriangleEnv
+    from .features.core import get_feature_extractor
+    from .nn.network import NeuralNetwork
+    from .rl import SelfPlayEngine, Trainer
+
+    def say(msg: str) -> None:
+        logger.info(msg)
+        if progress is not None:
+            progress(msg)
+
+    t_start = time.time()
+    backend = jax.default_backend()
+    cache = get_compile_cache()
+    say(
+        f"warm: backend={backend} scale={plan.scale} "
+        f"batch={plan.sp_batch} chunk={plan.chunk} sims={plan.sims} "
+        f"cache={cache.cache_dir}"
+    )
+
+    # Exactly the construction sequence run_bench performs — the cache
+    # signatures must match the bench's dispatch arguments bit for bit.
+    env = TriangleEnv(plan.env)
+    extractor = get_feature_extractor(env, plan.model)
+    net = NeuralNetwork(plan.model, plan.env, seed=0)
+    engine = SelfPlayEngine(
+        env, extractor, net, plan.mcts, plan.train, seed=0
+    )
+    trainer = Trainer(net, plan.train)
+
+    targets: list[tuple[str, object]] = [
+        (
+            f"self_play_chunk/t{plan.chunk}",
+            lambda: engine.warm_chunk(plan.chunk),
+        ),
+        (
+            f"learner_step/b{plan.lbatch}",
+            lambda: trainer.warm_step(plan.lbatch),
+        ),
+        (
+            f"learner_fused/k{plan.fused_k}",
+            lambda: trainer.warm_steps(plan.fused_k, plan.lbatch),
+        ),
+    ]
+    if plan.overlap_k != plan.fused_k and not plan.device_replay:
+        targets.append(
+            (
+                f"learner_fused/k{plan.overlap_k}",
+                lambda: trainer.warm_steps(plan.overlap_k, plan.lbatch),
+            )
+        )
+    if plan.device_replay:
+        from .rl.device_buffer import DeviceReplayBuffer
+
+        dev_buffer = DeviceReplayBuffer(
+            plan.train,
+            grid_shape=(
+                plan.model.GRID_INPUT_CHANNELS,
+                plan.env.ROWS,
+                plan.env.COLS,
+            ),
+            other_dim=extractor.other_dim,
+            action_dim=plan.env.action_dim,
+        )
+        targets.append(
+            (
+                f"learner_from_ring/k{plan.fused_k}",
+                lambda: trainer.warm_steps_from(
+                    dev_buffer, plan.fused_k, plan.lbatch
+                ),
+            )
+        )
+        if plan.overlap_k != plan.fused_k:
+            targets.append(
+                (
+                    f"learner_from_ring/k{plan.overlap_k}",
+                    lambda: trainer.warm_steps_from(
+                        dev_buffer, plan.overlap_k, plan.lbatch
+                    ),
+                )
+            )
+    if programs:
+        targets = [
+            (name, fn)
+            for name, fn in targets
+            if any(p in name for p in programs)
+        ]
+
+    def run_one(name: str, fn) -> dict:
+        t0 = time.time()
+        try:
+            aot = bool(fn())
+            status = "aot" if aot else "jit-fallback"
+        except Exception as exc:  # a warm failure must not kill the rest
+            logger.exception("warm: %s failed", name)
+            status = f"error: {type(exc).__name__}: {exc}"
+        dt = time.time() - t0
+        say(f"warm: {name}: {status} ({dt:.1f}s)")
+        return {"program": name, "status": status, "seconds": round(dt, 1)}
+
+    # Parallel lower+compile: XLA releases the GIL during compilation,
+    # so distinct programs genuinely overlap.
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(1, jobs)
+    ) as pool:
+        futures = [pool.submit(run_one, name, fn) for name, fn in targets]
+        rows = [f.result() for f in futures]
+
+    stats = cache.stats()
+    total = time.time() - t_start
+    say(
+        f"warm: done in {total:.1f}s — {stats['hits']} hit(s), "
+        f"{stats['misses']} miss(es) now serialized for the next process"
+    )
+    return {"programs": rows, "stats": stats, "seconds": round(total, 1)}

@@ -219,13 +219,8 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     import jax
     import numpy as np
 
-    from alphatriangle_tpu.config import (
-        AlphaTriangleMCTSConfig,
-        EnvConfig,
-        ModelConfig,
-        TrainConfig,
-        expected_other_features_dim,
-    )
+    from alphatriangle_tpu.bench_config import resolve_bench_plan
+    from alphatriangle_tpu.compile_cache import get_compile_cache
     from alphatriangle_tpu.env.engine import TriangleEnv
     from alphatriangle_tpu.features.core import get_feature_extractor
     from alphatriangle_tpu.nn.network import NeuralNetwork
@@ -241,174 +236,27 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     # must skip CPU (XLA:CPU AOT reloads carry a SIGILL risk) even when
     # an auto run landed there without a pinned platform.
     enable_persistent_compilation_cache(backend=backend)
+    # The AOT executable cache (compile_cache.py) covers the gap the
+    # XLA persistent cache leaves: it works on CPU too, skips tracing/
+    # lowering bookkeeping inside the window on a hit, and `cli warm`
+    # (run by benchmarks/tpu_watch.sh on every successful probe) fills
+    # it BEFORE a healthy window opens.
+    compile_cache = get_compile_cache()
     device = jax.devices()[0]
     log(
         "bench: backend="
         f"{backend} device={getattr(device, 'device_kind', device)}"
     )
 
-    preset = os.environ.get("BENCH_CONFIG")
-    if preset:
-        # One of the five BASELINE configs (config/presets.py).
-        from alphatriangle_tpu.config import baseline_preset
-
-        from alphatriangle_tpu.config import TrainConfig as _TrainConfig
-
-        bundle = baseline_preset(int(preset), run_name="bench")
-        env_cfg, model_cfg = bundle["env"], bundle["model"]
-        # Honor the A/B knobs in the preset path too (a silently
-        # ignored knob would mislabel the measurement).
-        preset_mcts_updates: dict = {
-            "descent_gather": os.environ.get("BENCH_GATHER", "einsum")
-        }
-        if os.environ.get("BENCH_WAVE"):
-            preset_mcts_updates["mcts_batch_size"] = int(
-                os.environ["BENCH_WAVE"]
-            )
-        if os.environ.get("BENCH_FAST_SIMS"):
-            preset_mcts_updates["fast_simulations"] = int(
-                os.environ["BENCH_FAST_SIMS"]
-            )
-            preset_mcts_updates["full_search_prob"] = float(
-                os.environ.get("BENCH_FULL_PROB", "0.25")
-            )
-        preset_recipe = os.environ.get("BENCH_RECIPE")
-        if preset_recipe not in (None, "", "puct", "gumbel_pcr"):
-            raise SystemExit(
-                f"Unknown BENCH_RECIPE={preset_recipe!r} "
-                "(valid: puct, gumbel_pcr) — refusing to run a "
-                "mislabeled measurement."
-            )
-        if preset_recipe == "puct":
-            preset_mcts_updates["root_selection"] = "puct"
-            preset_mcts_updates.setdefault("fast_simulations", None)
-        elif preset_recipe == "gumbel_pcr":
-            preset_mcts_updates["root_selection"] = "gumbel"
-            preset_mcts_updates.setdefault(
-                "fast_simulations",
-                max(1, bundle["mcts"].max_simulations // 4),
-            )
-            preset_mcts_updates.setdefault("full_search_prob", 0.25)
-        mcts_cfg = bundle["mcts"].model_copy(update=preset_mcts_updates)
-        train_updates = {
-            "BUFFER_CAPACITY": 10_000,
-            "MIN_BUFFER_SIZE_TO_TRAIN": 1_000,
-            "MAX_TRAINING_STEPS": 1_000,
-        }
-        if backend == "cpu" or smoke:
-            # Neither a CPU nor a smoke run can push the preset's full
-            # lane count; keep the net/search knobs, shrink lanes.
-            cap = 16 if smoke else 64
-            train_updates["SELF_PLAY_BATCH_SIZE"] = min(
-                cap, bundle["train"].SELF_PLAY_BATCH_SIZE
-            )
-            train_updates["ROLLOUT_CHUNK_MOVES"] = 4
-        if os.environ.get("BENCH_BATCH"):
-            # Lane-count A/B (see the non-preset path note). Still
-            # bounded by the cpu/smoke clamp above: a flagship lane
-            # count on a CPU fallback would blow the whole budget on
-            # one chunk.
-            requested = int(os.environ["BENCH_BATCH"])
-            if backend == "cpu" or smoke:
-                requested = min(
-                    requested, train_updates["SELF_PLAY_BATCH_SIZE"]
-                )
-            train_updates["SELF_PLAY_BATCH_SIZE"] = requested
-        if backend == "cpu":
-            model_cfg = model_cfg.model_copy(
-                update={"COMPUTE_DTYPE": "float32"}
-            )
-        # Rebuild via the constructor so validation + schedule-length
-        # derivation run against the bench horizon.
-        base_kw = bundle["train"].model_dump()
-        base_kw.pop("LR_SCHEDULER_T_MAX", None)
-        base_kw.pop("PER_BETA_ANNEAL_STEPS", None)
-        base_kw.update(train_updates)
-        train_cfg = _TrainConfig(**base_kw)
-        scale = f"baseline_config_{preset}"
-        sims = mcts_cfg.max_simulations
-        sp_batch = train_cfg.SELF_PLAY_BATCH_SIZE
-        chunk = train_cfg.ROLLOUT_CHUNK_MOVES
-        lbatch = train_cfg.BATCH_SIZE
-        log(f"bench: {scale}: {bundle['description']}")
-    else:
-        # Three scales: smoke (sanity), cpu (a CPU can't push the
-        # flagship load — one flagship chunk is ~30 min of CPU leaf
-        # evals — so the fallback measures a reduced but honest
-        # config), flagship (TPU).
-        if smoke:
-            scale, sims, depth, sp_batch, chunk, lbatch = (
-                "smoke", 8, 4, 16, 4, 32,
-            )
-        elif backend == "cpu":
-            scale, sims, depth, sp_batch, chunk, lbatch = (
-                "cpu", 16, 8, 64, 4, 128,
-            )
-        else:
-            scale, sims, depth, sp_batch, chunk, lbatch = (
-                "flagship", 64, 8, 512, 16, 256,
-            )
-        env_cfg = EnvConfig()
-        model_cfg = ModelConfig(
-            OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
-            COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
-        )
-        mcts_kw: dict = {}
-        if os.environ.get("BENCH_FAST_SIMS"):
-            # Playout cap randomization A/B (KataGo; docs in
-            # config/mcts_config.py): BENCH_FAST_SIMS=16 [BENCH_FULL_PROB=0.25]
-            mcts_kw["fast_simulations"] = int(os.environ["BENCH_FAST_SIMS"])
-            mcts_kw["full_search_prob"] = float(
-                os.environ.get("BENCH_FULL_PROB", "0.25")
-            )
-        if os.environ.get("BENCH_WAVE"):
-            # Wave-size A/B: simulations evaluated in parallel per tree
-            # (the MXU batch per eval is SELF_PLAY_BATCH_SIZE x wave).
-            mcts_kw["mcts_batch_size"] = int(os.environ["BENCH_WAVE"])
-        if os.environ.get("BENCH_BATCH"):
-            # Lane-count A/B: more lockstep games per dispatch = bigger
-            # MXU batches per wave eval (flagship B=512 measured 1.4%
-            # self-play MFU — lane count is the direct lever on it).
-            # On cpu/smoke the scale's own lane count is the ceiling: a
-            # flagship lane count on a CPU fallback would blow the whole
-            # budget on one chunk.
-            requested = int(os.environ["BENCH_BATCH"])
-            if scale in ("cpu", "smoke"):
-                requested = min(requested, sp_batch)
-            sp_batch = requested
-        recipe = os.environ.get(
-            "BENCH_RECIPE", "gumbel_pcr" if scale == "flagship" else "puct"
-        )
-        if recipe not in ("puct", "gumbel_pcr"):
-            raise SystemExit(
-                f"Unknown BENCH_RECIPE={recipe!r} (valid: puct, "
-                "gumbel_pcr) — refusing to run a mislabeled measurement."
-            )
-        if recipe == "gumbel_pcr":
-            # The flagship training recipe: Gumbel root + playout cap
-            # randomization — the measured-best learning arm (+11%
-            # converged eval at <1/2 search cost, BASELINE.md A/Bs).
-            # BENCH_RECIPE=puct measures the reference-parity search.
-            mcts_kw["root_selection"] = "gumbel"
-            mcts_kw.setdefault("fast_simulations", max(1, sims // 4))
-            mcts_kw.setdefault("full_search_prob", 0.25)
-        mcts_cfg = AlphaTriangleMCTSConfig(
-            max_simulations=sims,
-            max_depth=depth,
-            # A/B knob for the descent row-gather lowering
-            # (ops/gather_rows.py).
-            descent_gather=os.environ.get("BENCH_GATHER", "einsum"),
-            **mcts_kw,
-        )
-        train_cfg = TrainConfig(
-            SELF_PLAY_BATCH_SIZE=sp_batch,
-            ROLLOUT_CHUNK_MOVES=chunk,
-            BATCH_SIZE=lbatch,
-            BUFFER_CAPACITY=10_000,
-            MIN_BUFFER_SIZE_TO_TRAIN=1_000,
-            MAX_TRAINING_STEPS=1_000,
-            RUN_NAME="bench",
-        )
+    # The plan is shared with `cli warm` so the warmer precompiles
+    # exactly the shapes measured here (alphatriangle_tpu/bench_config.py).
+    plan = resolve_bench_plan(smoke, backend)
+    env_cfg, model_cfg = plan.env, plan.model
+    mcts_cfg, train_cfg = plan.mcts, plan.train
+    scale, sims = plan.scale, plan.sims
+    sp_batch, chunk, lbatch = plan.sp_batch, plan.chunk, plan.lbatch
+    if os.environ.get("BENCH_CONFIG"):
+        log(f"bench: {scale}: {plan.description}")
     log(f"bench: scale={scale} sims={sims} batch={sp_batch} chunk={chunk}")
 
     env = TriangleEnv(env_cfg)
@@ -525,6 +373,9 @@ def run_bench(smoke: bool, seconds: float) -> dict:
 
     def snapshot(partial: "str | None") -> dict:
         global _last_partial
+        # Refreshed at every snapshot: later sections (learner, fused,
+        # device-replay, overlapped) add their own compiles/hits.
+        extra["compile_cache"] = compile_cache.stats()
         r = {
             "metric": "self_play_games_per_hour",
             "value": round(games_per_hour, 1),
@@ -568,8 +419,9 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     # Fused groups: K steps per dispatch (one round trip per group) —
     # the FUSED_LEARNER_STEPS path the loop uses on tunneled chips.
     # CPU unrolls the group (see Trainer._train_steps_impl), so keep K
-    # small there to bound compile time.
-    fused_k = 4 if (smoke or backend == "cpu") else 16
+    # small there to bound compile time. (K values live in the shared
+    # plan so `cli warm` precompiles the same fused programs.)
+    fused_k = plan.fused_k
     fused_batches = [batch] * fused_k
     trainer.train_steps(fused_batches)  # compile
     n_groups = 2 if smoke else 5
@@ -609,7 +461,7 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     # between link-bound and compute-bound on a tunneled/PCIe-fed chip.
     # Measured on every backend except CPU (where host and "device"
     # memory are the same RAM and the comparison is meaningless).
-    device_replay = backend != "cpu" and not smoke
+    device_replay = plan.device_replay
     dev_buffer = None
     dev_steps_per_sec = None
     if device_replay:
@@ -686,7 +538,7 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     async_chunk = max(1, min(chunk, round(async_target_s / per_move_s)))
     # Larger fused groups amortize the producer interleave: the learner
     # runs K steps per time slice between rollout chunks.
-    overlap_k = fused_k if (smoke or backend == "cpu") else 64
+    overlap_k = plan.overlap_k
     overlap_batches = [batch] * overlap_k
     if device_replay:
         # Warm the K-sized device-gather program OUTSIDE the timed

@@ -46,5 +46,12 @@ if [ ! -f benchmarks/reuse_bet_results.json ] && [ "$(runway)" -gt 1500 ] \
     --run-name tpu_flagship_r5 --root-dir /tmp/tpu_r5_train || true
 fi
 
-[ "$(runway)" -gt 600 ] || exit 0
-bash benchmarks/tpu_round5.sh
+r=$(runway)
+[ "$r" -gt 600 ] || exit 0
+# Cap the final full sweep with the remaining runway: an uncapped
+# sweep could hold the chip straight through ORCH_END_BY, turning the
+# round driver's own bench attempt into a CPU fallback — the exact
+# contention the hard-deadline contract exists to prevent. timeout's
+# TERM propagates to the sweep's children (each section is resumable,
+# so a cut-off sweep just resumes in the next healthy window).
+timeout $(( r - 60 )) bash benchmarks/tpu_round5.sh

@@ -9,12 +9,27 @@
 #   WATCH_BUDGET_S  total wall budget (default 6h)
 #   WATCH_CMD       command to run in a healthy window
 #                   (default: bash benchmarks/tpu_round4.sh)
+#   WATCH_WARM_S    budget for the post-probe compile-cache warm
+#                   (default 900; 0 disables warming)
 set -u
 cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + ${WATCH_BUDGET_S:-21600} ))
 cmd=${WATCH_CMD:-"bash benchmarks/tpu_round4.sh"}
+warm_s=${WATCH_WARM_S:-900}
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    # Probe passed: warm the compile caches (XLA persistent + AOT
+    # executables, docs/COMPILE_CACHE.md) for the bench/sweep shapes
+    # BEFORE spending the window on the real command — on a warm cache
+    # this is seconds; cold, it front-loads the ~minute-per-program
+    # compiles so the sweep's sections start measuring immediately.
+    # Best-effort: a warm failure (or a wedge mid-warm) must not stop
+    # the sweep attempt.
+    if [ "$warm_s" -gt 0 ]; then
+      echo "$(date +%T) chip healthy; warming compile caches (<=${warm_s}s)" >&2
+      timeout "$warm_s" python -m alphatriangle_tpu.cli warm auto >&2 \
+        || echo "$(date +%T) warm incomplete (continuing)" >&2
+    fi
     echo "$(date +%T) chip healthy; running: $cmd" >&2
     if eval "$cmd"; then
       echo "$(date +%T) command complete" >&2

@@ -1,0 +1,465 @@
+"""Compile-latency subsystem tests (compile_cache.py, warm.py, cli warm).
+
+Cold-vs-warm is asserted via the cache's hit/miss counters and the
+on-disk executable files — never wall clock (CI machines make timing
+assertions flaky). The cross-PROCESS reuse property is exercised
+in-process by resetting the process-global cache between engines: a
+fresh `CompileCache` has no in-memory executables, so a hit can only
+come from deserializing the serialized artifact, exactly what a new
+process would do.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.compile_cache import (
+    CompileCache,
+    config_digest,
+    get_compile_cache,
+    reset_compile_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def no_xla_persistent_cache():
+    """Disable the XLA persistent cache for this module (conftest turns
+    it on for suite speed). This mirrors the real CPU environment —
+    `enable_persistent_compilation_cache` skips CPU — and matters for
+    correctness here: an executable that compile() loads FROM the
+    persistent cache serializes to a truncated payload on XLA:CPU, so
+    with it on, fresh AOT artifacts could never be published (the
+    validation round trip in `_serialize` rejects them).
+
+    jax LATCHES cache-used at the first compile of the process, so in
+    a full-suite run (where earlier test files already compiled through
+    the cache) flipping the config alone does nothing: the latch must
+    be reset too (`compilation_cache.reset_cache`)."""
+    from jax._src import compilation_cache as _cc
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+    _cc.reset_cache()  # re-latch with the cache enabled for later tests
+
+
+@pytest.fixture()
+def fresh_cache(tmp_path):
+    """Point the process-global cache at an empty tmp dir; restore the
+    default afterwards so other tests keep their shared cache."""
+    cache = reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+    yield cache
+    reset_compile_cache()
+
+
+def _double(x):
+    return x * 2.0
+
+
+class TestCachedProgram:
+    def test_roundtrip_serialize_deserialize_cpu(self, fresh_cache):
+        """An executable serialized by one cache instance is
+        deserialized (hit) by a fresh instance — the cross-process
+        path — and computes the same answer."""
+        x = jnp.arange(6.0).reshape(2, 3)
+        prog = fresh_cache.wrap("t/double", jax.jit(_double))
+        cold = np.asarray(prog(x))
+        assert fresh_cache.misses == 1 and fresh_cache.hits == 0
+        files = list(fresh_cache.cache_dir.glob("*.jaxexe"))
+        assert len(files) == 1  # serialized artifact on disk
+
+        second = CompileCache(cache_dir=str(fresh_cache.cache_dir))
+        prog2 = second.wrap("t/double", jax.jit(_double))
+        warm = np.asarray(prog2(x))
+        assert second.hits == 1 and second.misses == 0
+        np.testing.assert_array_equal(cold, warm)
+
+    def test_warm_populates_without_executing(self, fresh_cache):
+        calls = []
+
+        def spy(x):
+            calls.append(1)
+            return x + 1
+
+        prog = fresh_cache.wrap("t/spy", jax.jit(spy))
+        x = jnp.ones((3,))
+        assert prog.warm(x) is True  # compiles + serializes
+        assert fresh_cache.misses == 1
+        # warm() traced (to lower) but never executed on real data;
+        # the later call reuses the in-memory executable (no new event).
+        out = np.asarray(prog(x))
+        np.testing.assert_array_equal(out, np.full(3, 2.0))
+        assert fresh_cache.misses == 1 and fresh_cache.hits == 0
+
+    def test_shape_mismatch_is_fresh_compile_not_hit(self, fresh_cache):
+        prog = fresh_cache.wrap("t/double", jax.jit(_double))
+        prog(jnp.ones((2, 3)))
+        second = CompileCache(cache_dir=str(fresh_cache.cache_dir))
+        prog2 = second.wrap("t/double", jax.jit(_double))
+        # Different shape -> different signature -> miss, new artifact.
+        prog2(jnp.ones((4, 5)))
+        assert second.hits == 0 and second.misses == 1
+        assert len(list(second.cache_dir.glob("*.jaxexe"))) == 2
+        # Same shape again -> hit against the first artifact.
+        prog3 = CompileCache(cache_dir=str(fresh_cache.cache_dir)).wrap(
+            "t/double", jax.jit(_double)
+        )
+        prog3(jnp.ones((2, 3)))
+
+    def test_config_extra_splits_the_key(self, fresh_cache):
+        x = jnp.ones((2, 2))
+        fresh_cache.wrap("t/double", jax.jit(_double), extra="cfgA")(x)
+        second = CompileCache(cache_dir=str(fresh_cache.cache_dir))
+        second.wrap("t/double", jax.jit(_double), extra="cfgB")(x)
+        # Same avals, different config digest: must NOT reuse.
+        assert second.hits == 0 and second.misses == 1
+
+    def test_corrupt_artifact_degrades_to_recompile(self, fresh_cache):
+        x = jnp.ones((2, 2))
+        fresh_cache.wrap("t/double", jax.jit(_double))(x)
+        (artifact,) = fresh_cache.cache_dir.glob("*.jaxexe")
+        artifact.write_bytes(b"not a pickle")
+        second = CompileCache(cache_dir=str(fresh_cache.cache_dir))
+        out = second.wrap("t/double", jax.jit(_double))(x)
+        np.testing.assert_array_equal(np.asarray(out), np.full((2, 2), 2.0))
+        assert second.deserialize_errors == 1
+        assert second.misses == 1 and second.hits == 0
+
+    def test_disabled_cache_delegates_to_jit(self, tmp_path):
+        cache = CompileCache(cache_dir=str(tmp_path), enabled=False)
+        prog = cache.wrap("t/double", jax.jit(_double))
+        out = prog(jnp.ones((2, 2)))
+        np.testing.assert_array_equal(np.asarray(out), np.full((2, 2), 2.0))
+        assert cache.hits == cache.misses == 0
+        assert not list(tmp_path.glob("*.jaxexe"))
+
+    def test_donated_args_work_through_the_aot_path(self, fresh_cache):
+        def bump(state, dx):
+            return state + dx
+
+        prog = fresh_cache.wrap(
+            "t/donate", jax.jit(bump, donate_argnums=(0,))
+        )
+        state = jnp.zeros((4,))
+        for i in range(3):  # state threads through donated calls
+            state = prog(state, jnp.ones((4,)))
+        np.testing.assert_array_equal(np.asarray(state), np.full(4, 3.0))
+
+    def test_compile_spans_reach_the_tracer(self, fresh_cache):
+        from alphatriangle_tpu.telemetry import SpanTracer
+
+        tracer = SpanTracer(enabled=True)
+        fresh_cache.set_tracer(tracer)
+        fresh_cache.wrap("t/double", jax.jit(_double))(jnp.ones((2,)))
+        names = {s[1] for s in tracer._snapshot()}
+        assert "compile/t/double" in names
+
+    def test_config_digest_ignores_run_name(self, tiny_train_config):
+        a = config_digest(tiny_train_config)
+        b = config_digest(
+            tiny_train_config.model_copy(update={"RUN_NAME": "other"})
+        )
+        c = config_digest(
+            tiny_train_config.model_copy(update={"GAMMA": 0.5})
+        )
+        assert a == b
+        assert a != c
+
+
+class TestEngineAndTrainerReuse:
+    """The acceptance property: the rollout-chunk and learner programs
+    are genuinely reused across cache instances (counter-proven)."""
+
+    def _engine(self, env_cfg, model_cfg, mcts_cfg, train_cfg, seed=0):
+        from alphatriangle_tpu.env.engine import TriangleEnv
+        from alphatriangle_tpu.features.core import get_feature_extractor
+        from alphatriangle_tpu.nn.network import NeuralNetwork
+        from alphatriangle_tpu.rl import SelfPlayEngine
+
+        env = TriangleEnv(env_cfg)
+        extractor = get_feature_extractor(env, model_cfg)
+        net = NeuralNetwork(model_cfg, env_cfg, seed=seed)
+        return SelfPlayEngine(
+            env, extractor, net, mcts_cfg, train_cfg, seed=seed
+        )
+
+    def test_rollout_chunk_cold_then_warm(
+        self,
+        tmp_path,
+        tiny_env_config,
+        tiny_model_config,
+        tiny_mcts_config,
+        tiny_train_config,
+    ):
+        cache_dir = str(tmp_path / "aot")
+        try:
+            cold = reset_compile_cache(cache_dir=cache_dir)
+            e1 = self._engine(
+                tiny_env_config,
+                tiny_model_config,
+                tiny_mcts_config,
+                tiny_train_config,
+            )
+            e1.play_chunk(2)
+            assert cold.misses >= 1 and cold.hits == 0
+
+            warm = reset_compile_cache(cache_dir=cache_dir)
+            e2 = self._engine(
+                tiny_env_config,
+                tiny_model_config,
+                tiny_mcts_config,
+                tiny_train_config,
+            )
+            e2.play_chunk(2)  # same shapes -> deserialized executable
+            assert warm.hits == 1 and warm.misses == 0
+            r = e2.harvest()
+            assert r.num_episodes >= 0  # the reused program really ran
+        finally:
+            reset_compile_cache()
+
+    def test_warm_chunk_then_play_needs_no_more_compiles(
+        self,
+        tmp_path,
+        tiny_env_config,
+        tiny_model_config,
+        tiny_mcts_config,
+        tiny_train_config,
+    ):
+        try:
+            cache = reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+            engine = self._engine(
+                tiny_env_config,
+                tiny_model_config,
+                tiny_mcts_config,
+                tiny_train_config,
+            )
+            assert engine.warm_chunk(2) is True
+            events_after_warm = len(cache.events)
+            engine.play_chunk(2)
+            # Dispatch found the warmed executable: no new compile event.
+            assert len(cache.events) == events_after_warm
+        finally:
+            reset_compile_cache()
+
+    def test_trainer_step_cold_then_warm(
+        self, tmp_path, tiny_env_config, tiny_model_config, tiny_train_config
+    ):
+        from alphatriangle_tpu.nn.network import NeuralNetwork
+        from alphatriangle_tpu.rl import Trainer
+
+        b = tiny_train_config.BATCH_SIZE
+        rng = np.random.default_rng(0)
+        batch = {
+            "grid": rng.random(
+                (b, 1, tiny_env_config.ROWS, tiny_env_config.COLS)
+            ).astype(np.float32),
+            "other_features": rng.random(
+                (b, tiny_model_config.OTHER_NN_INPUT_FEATURES_DIM)
+            ).astype(np.float32),
+            "policy_target": np.full(
+                (b, tiny_env_config.action_dim),
+                1.0 / tiny_env_config.action_dim,
+                np.float32,
+            ),
+            "value_target": np.zeros(b, np.float32),
+            "weights": np.ones(b, np.float32),
+        }
+        cache_dir = str(tmp_path / "aot")
+        try:
+            cold = reset_compile_cache(cache_dir=cache_dir)
+            net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+            t1 = Trainer(net, tiny_train_config)
+            out1 = t1.train_step(dict(batch))
+            assert out1 is not None
+            assert cold.misses >= 1 and cold.hits == 0
+
+            warm = reset_compile_cache(cache_dir=cache_dir)
+            net2 = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+            t2 = Trainer(net2, tiny_train_config)
+            out2 = t2.train_step(dict(batch))
+            assert out2 is not None
+            assert warm.hits == 1 and warm.misses == 0
+            # Same seed, same batch, reused executable: same loss.
+            assert out1[0]["total_loss"] == pytest.approx(
+                out2[0]["total_loss"], rel=1e-5
+            )
+        finally:
+            reset_compile_cache()
+
+    def test_fused_steps_warm_covers_dispatch(
+        self, tmp_path, tiny_env_config, tiny_model_config, tiny_train_config
+    ):
+        from alphatriangle_tpu.nn.network import NeuralNetwork
+        from alphatriangle_tpu.rl import Trainer
+
+        try:
+            cache = reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+            net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+            trainer = Trainer(net, tiny_train_config)
+            assert trainer.warm_steps(3) is True
+            events_after_warm = len(cache.events)
+            b = tiny_train_config.BATCH_SIZE
+            batch = trainer._zero_batch(b)
+            results = trainer.train_steps([dict(batch)] * 3)
+            assert len(results) == 3
+            assert len(cache.events) == events_after_warm  # no new compile
+        finally:
+            reset_compile_cache()
+
+
+class TestBenchPlan:
+    def test_plan_matches_bench_scales(self):
+        from alphatriangle_tpu.bench_config import resolve_bench_plan
+
+        smoke = resolve_bench_plan(True, "cpu", environ={})
+        assert (smoke.scale, smoke.sims, smoke.sp_batch) == ("smoke", 8, 16)
+        assert smoke.fused_k == smoke.overlap_k == 4
+        assert smoke.device_replay is False
+
+        cpu = resolve_bench_plan(False, "cpu", environ={})
+        assert (cpu.scale, cpu.sp_batch, cpu.chunk) == ("cpu", 64, 4)
+
+        tpu = resolve_bench_plan(False, "tpu", environ={})
+        assert (tpu.scale, tpu.sp_batch, tpu.lbatch) == ("flagship", 512, 256)
+        assert tpu.mcts.root_selection == "gumbel"
+        assert (tpu.fused_k, tpu.overlap_k, tpu.device_replay) == (16, 64, True)
+
+    def test_plan_honors_ab_knobs(self):
+        from alphatriangle_tpu.bench_config import resolve_bench_plan
+
+        plan = resolve_bench_plan(
+            False,
+            "tpu",
+            environ={"BENCH_RECIPE": "puct", "BENCH_BATCH": "256"},
+        )
+        assert plan.mcts.root_selection == "puct"
+        assert plan.sp_batch == 256
+
+        with pytest.raises(SystemExit):
+            resolve_bench_plan(
+                False, "tpu", environ={"BENCH_RECIPE": "bogus"}
+            )
+
+    def test_preset_plan_builds(self):
+        from alphatriangle_tpu.bench_config import resolve_bench_plan
+
+        plan = resolve_bench_plan(
+            False, "cpu", environ={"BENCH_CONFIG": "1"}
+        )
+        assert plan.scale == "baseline_config_1"
+        assert plan.sp_batch <= 64  # cpu lane clamp
+        assert plan.train.ROLLOUT_CHUNK_MOVES == 4
+
+
+class TestWarmCLI:
+    def test_cli_warm_smoke(
+        self,
+        tmp_path,
+        monkeypatch,
+        capsys,
+        tiny_env_config,
+        tiny_model_config,
+        tiny_mcts_config,
+        tiny_train_config,
+    ):
+        """`cli warm` end to end on a tiny plan: compiles the rollout
+        chunk + learner programs, serializes them, prints a JSON report,
+        and a second invocation is all hits."""
+        from alphatriangle_tpu import cli
+        from alphatriangle_tpu.bench_config import BenchPlan
+
+        def tiny_plan(smoke, backend, environ=None):
+            return BenchPlan(
+                env=tiny_env_config,
+                model=tiny_model_config,
+                mcts=tiny_mcts_config,
+                train=tiny_train_config,
+                scale="tiny",
+                sims=tiny_mcts_config.max_simulations,
+                sp_batch=tiny_train_config.SELF_PLAY_BATCH_SIZE,
+                chunk=tiny_train_config.ROLLOUT_CHUNK_MOVES,
+                lbatch=tiny_train_config.BATCH_SIZE,
+                fused_k=2,
+                overlap_k=2,
+                device_replay=False,
+            )
+
+        monkeypatch.setattr(
+            "alphatriangle_tpu.bench_config.resolve_bench_plan", tiny_plan
+        )
+        try:
+            reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+            rc = cli.main(["warm", "smoke", "--jobs", "2"])
+            out = capsys.readouterr().out
+            report = json.loads(out.strip().splitlines()[-1])
+            assert rc == 0
+            assert {r["status"] for r in report["programs"]} == {"aot"}
+            assert report["stats"]["misses"] == len(report["programs"]) >= 3
+
+            reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+            rc2 = cli.main(["warm", "smoke", "--jobs", "2"])
+            report2 = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1]
+            )
+            assert rc2 == 0
+            assert report2["stats"]["hits"] == len(report2["programs"])
+            assert report2["stats"]["misses"] == 0
+        finally:
+            reset_compile_cache()
+
+    def test_cli_warm_program_filter(
+        self,
+        tmp_path,
+        monkeypatch,
+        capsys,
+        tiny_env_config,
+        tiny_model_config,
+        tiny_mcts_config,
+        tiny_train_config,
+    ):
+        from alphatriangle_tpu import cli
+        from alphatriangle_tpu.bench_config import BenchPlan
+
+        monkeypatch.setattr(
+            "alphatriangle_tpu.bench_config.resolve_bench_plan",
+            lambda smoke, backend, environ=None: BenchPlan(
+                env=tiny_env_config,
+                model=tiny_model_config,
+                mcts=tiny_mcts_config,
+                train=tiny_train_config,
+                scale="tiny",
+                sims=8,
+                sp_batch=4,
+                chunk=4,
+                lbatch=4,
+                fused_k=2,
+                overlap_k=2,
+            ),
+        )
+        try:
+            reset_compile_cache(cache_dir=str(tmp_path / "aot"))
+            rc = cli.main(
+                ["warm", "smoke", "--programs", "learner_step", "--jobs", "1"]
+            )
+            report = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1]
+            )
+            assert rc == 0
+            assert [r["program"] for r in report["programs"]] == [
+                "learner_step/b4"
+            ]
+        finally:
+            reset_compile_cache()
+
+
+class TestGlobalCache:
+    def test_global_accessor_is_a_singleton(self):
+        try:
+            a = reset_compile_cache()
+            assert get_compile_cache() is a
+        finally:
+            reset_compile_cache()
