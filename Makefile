@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke
+.PHONY: check lint type test bench-smoke perf-smoke
 
 check: lint type test
 
@@ -41,3 +41,13 @@ test:
 
 bench-smoke:
 	BENCH_SMOKE=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Metrics-ledger pipeline gate: a short CPU training run must produce a
+# parseable metrics.jsonl, `cli perf` must summarize it (exit 2 = the
+# ledger schema broke), and `cli compare` must hold against the
+# checked-in reference summary (generous threshold — CI hosts vary in
+# speed; the hard signal is schema alignment + "not catastrophically
+# slower"). Regenerate the reference after intentional schema changes:
+#   $(PY) benchmarks/perf_smoke.py --write-reference
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/perf_smoke.py

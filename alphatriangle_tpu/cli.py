@@ -483,16 +483,23 @@ def cmd_watch(args: argparse.Namespace) -> int:
     it is safe to run beside a training process on a sick-chip day."""
     import time as _time
 
-    from .stats.watch import WatchState, render_frame, tail_live_metrics
+    from .stats.watch import (
+        WatchState,
+        render_frame,
+        tail_ledger_utils,
+        tail_live_metrics,
+    )
     from .telemetry.health import read_health
 
     run_dir = _resolve_run_dir(args.run_name, args.root_dir)
     if run_dir is None:
         return 1
     live = run_dir / "live_metrics.jsonl"
+    ledger = run_dir / "metrics.jsonl"
     heartbeat = run_dir / "health.json"
     state = WatchState()
     offset = tail_live_metrics(live, state, 0)
+    ledger_offset = tail_ledger_utils(ledger, state, 0)
     if not live.exists():
         print(
             f"waiting for {live} (run still starting?) — Ctrl-C to stop",
@@ -506,6 +513,7 @@ def cmd_watch(args: argparse.Namespace) -> int:
         while True:
             _time.sleep(args.interval)
             offset = tail_live_metrics(live, state, offset)
+            ledger_offset = tail_ledger_utils(ledger, state, ledger_offset)
             # Redraw in place: move up over the previous frame.
             height = frame.count("\n") + 1
             frame = render_frame(
@@ -514,6 +522,165 @@ def cmd_watch(args: argparse.Namespace) -> int:
             print(f"\x1b[{height}F\x1b[0J" + frame, flush=True)
     except KeyboardInterrupt:
         return 0
+
+
+def _fmt_cell(value, spec: str = ",.2f", scale: float = 1.0, unit: str = "") -> str:
+    if not isinstance(value, (int, float)):
+        return "—"
+    return f"{value * scale:{spec}}{unit}"
+
+
+def cmd_perf(args: argparse.Namespace) -> int:
+    """Windowed performance summary of a run's metrics ledger: p50/p95
+    step time, MFU, throughput and its trend. Reads `metrics.jsonl`
+    only — never imports JAX, safe beside a wedged chip. Exit 0 on a
+    usable summary, 2 when the ledger is missing or holds no
+    utilization records (the schema-gate `make perf-smoke` relies on)."""
+    import json as _json
+
+    from .telemetry.ledger import read_ledger, resolve_ledger_path
+    from .telemetry.perf import summarize_utilization
+
+    target = Path(args.run) if args.run else None
+    if target is not None and target.exists():
+        ledger = resolve_ledger_path(target)
+    else:
+        run_dir = _resolve_run_dir(args.run, args.root_dir)
+        if run_dir is None:
+            return 2
+        ledger = resolve_ledger_path(run_dir)
+        if ledger is None:
+            print(f"no metrics ledger in {run_dir}", file=sys.stderr)
+            return 2
+    if ledger is None:
+        print(f"no metrics ledger at {args.run}", file=sys.stderr)
+        return 2
+    summary = summarize_utilization(
+        read_ledger(ledger, kinds={"util"}), window=args.window
+    )
+    if summary is None:
+        print(
+            f"{ledger}: no utilization records (run predates the "
+            "ledger, or telemetry was disabled)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        summary["source"] = str(ledger)
+        print(_json.dumps(summary))
+        return 0
+    peak = summary.get("peak_bf16_tflops")
+    trend = summary.get("throughput_trend")
+    print(f"perf {ledger}")
+    print(
+        f"  window       {summary['ticks']} tick(s)"
+        f" ({summary['ticks_total']} on record),"
+        f" steps {summary.get('first_step')}→{summary.get('last_step')},"
+        f" {_fmt_cell(summary.get('wall_seconds'), ',.0f', 1, 's')} wall"
+    )
+    print(
+        f"  device       {summary.get('device_kind') or '?'}"
+        f"   peak bf16 {_fmt_cell(peak, ',.0f', 1, ' TFLOP/s') if peak else 'unknown'}"
+        + (
+            f" [{summary.get('peak_source')}]"
+            if summary.get("peak_source")
+            else ""
+        )
+    )
+    print(
+        f"  learner      {_fmt_cell(summary.get('learner_steps_per_sec'))} steps/s"
+        f"   step p50 {_fmt_cell(summary.get('step_time_ms_p50'), ',.1f', 1, 'ms')}"
+        f"   p95 {_fmt_cell(summary.get('step_time_ms_p95'), ',.1f', 1, 'ms')}"
+    )
+    print(
+        f"  self-play    {_fmt_cell(summary.get('games_per_hour'), ',.1f')} games/h"
+        f"   {_fmt_cell(summary.get('moves_per_sec'), ',.1f')} moves/s"
+        f"   {_fmt_cell(summary.get('sims_per_sec'), ',.0f')} sims/s"
+    )
+    print(
+        f"  utilization  MFU {_fmt_cell(summary.get('mfu'), ',.2f', 100.0, '%')}"
+        f" (max {_fmt_cell(summary.get('mfu_max'), ',.2f', 100.0, '%')})"
+        f"   {_fmt_cell(summary.get('tflops_per_sec'))} TFLOP/s"
+    )
+    print(
+        f"  transfers    h2d {_fmt_cell(summary.get('transfer_h2d_ms'), ',.1f', 1, 'ms')}"
+        f"   d2h {_fmt_cell(summary.get('transfer_d2h_ms'), ',.1f', 1, 'ms')}"
+        f"   buffer fill {_fmt_cell(summary.get('buffer_fill_last'), ',.2f', 100.0, '%')}"
+        f"   compile hits {_fmt_cell(summary.get('compile_cache_hit_rate'), ',.0f', 100.0, '%')}"
+    )
+    print(
+        f"  trend        {_fmt_cell(trend, '+,.1f', 100.0, '%')} "
+        "(2nd-half vs 1st-half throughput)"
+    )
+    return 0
+
+
+def cmd_compare(args: argparse.Namespace) -> int:
+    """Aligned-metric regression report between two runs (or a run and
+    a BENCH_*.json / perf-summary snapshot). Exit 0 = parity or better,
+    1 = at least one metric regressed past --threshold, 2 = either side
+    unreadable — so a CI job or the bench supervisor can gate on it."""
+    import json as _json
+
+    from .telemetry.perf import compare_summaries, load_comparable
+
+    a, label_a = load_comparable(args.run_a, args.root_dir)
+    b, label_b = load_comparable(args.run_b, args.root_dir)
+    for side, loaded, label in (("A", a, label_a), ("B", b, label_b)):
+        if loaded is None:
+            print(f"compare: side {side}: {label}", file=sys.stderr)
+    if a is None or b is None:
+        return 2
+    rows, regressions = compare_summaries(a, b, threshold=args.threshold)
+    compared = [r for r in rows if r[4] != "n/a"]
+    if not compared:
+        print(
+            "compare: no aligned metrics between the two sides",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "a": label_a,
+                    "b": label_b,
+                    "threshold": args.threshold,
+                    "rows": [
+                        {
+                            "metric": m,
+                            "a": va,
+                            "b": vb,
+                            "ratio": ratio,
+                            "status": status,
+                        }
+                        for m, va, vb, ratio, status in rows
+                    ],
+                    "regressions": regressions,
+                }
+            )
+        )
+        return 1 if regressions else 0
+    print(f"compare  A = {label_a}")
+    print(f"         B = {label_b}   (threshold {args.threshold:.0%})")
+    width = max(len(r[0]) for r in rows)
+    print(
+        f"  {'metric':<{width}}  {'A':>12}  {'B':>12}  {'A/B':>7}  verdict"
+    )
+    for metric, va, vb, ratio, status in rows:
+        print(
+            f"  {metric:<{width}}  {_fmt_cell(va, ',.3f'):>12}  "
+            f"{_fmt_cell(vb, ',.3f'):>12}  "
+            f"{_fmt_cell(ratio, '.3f'):>7}  {status}"
+        )
+    if regressions:
+        print(
+            f"REGRESSION: {', '.join(regressions)} worse than baseline "
+            f"by more than {args.threshold:.0%}"
+        )
+        return 1
+    print("parity: no metric regressed past the threshold")
+    return 0
 
 
 def cmd_devices(_args: argparse.Namespace) -> int:
@@ -940,8 +1107,12 @@ def cmd_warm(args: argparse.Namespace) -> int:
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
     print(_json.dumps(report))
-    ok = all(r["status"] == "aot" for r in report["programs"])
-    return 0 if (ok and report["programs"]) else 1
+    # "skipped-cpu" rows are deliberate (learner programs never AOT on
+    # the CPU backend; rl/trainer.py cpu_aot note) — they must not fail
+    # the warm, but at least one program must actually be AOT-ready.
+    rows = report["programs"]
+    ok = all(r["status"] in ("aot", "skipped-cpu") for r in rows)
+    return 0 if (ok and any(r["status"] == "aot" for r in rows)) else 1
 
 
 def cmd_tune(args: argparse.Namespace) -> int:
@@ -1094,6 +1265,60 @@ def main(argv: list[str] | None = None) -> int:
         "watchdog deadline).",
     )
 
+    perf = sub.add_parser(
+        "perf",
+        help="Performance summary of a run's metrics ledger "
+        "(p50/p95 step time, MFU, throughput trend).",
+    )
+    perf.add_argument(
+        "run",
+        nargs="?",
+        default=None,
+        help="Run name, run dir, or metrics.jsonl path "
+        "(default: latest run).",
+    )
+    perf.add_argument("--root-dir", default=None)
+    perf.add_argument(
+        "--window",
+        type=int,
+        default=None,
+        metavar="N",
+        help="Summarize only the newest N utilization records "
+        "(default: the whole run).",
+    )
+    perf.add_argument(
+        "--json",
+        action="store_true",
+        help="Emit the summary as one JSON line (comparable input for "
+        "`compare`).",
+    )
+
+    comp = sub.add_parser(
+        "compare",
+        help="Aligned-metric regression report between two runs (or a "
+        "run and a BENCH_*.json / perf-summary snapshot); exit 0 "
+        "parity, 1 regression, 2 unreadable.",
+    )
+    comp.add_argument(
+        "run_a", help="Candidate: run name/dir, metrics.jsonl, or JSON."
+    )
+    comp.add_argument(
+        "run_b", help="Baseline: run name/dir, metrics.jsonl, or JSON "
+        "(e.g. BENCH_r05.json).",
+    )
+    comp.add_argument("--root-dir", default=None)
+    comp.add_argument(
+        "--threshold",
+        type=float,
+        default=0.1,
+        metavar="FRAC",
+        help="Regression tolerance: fail when a metric drops more than "
+        "this fraction below the baseline (default 0.1).",
+    )
+    comp.add_argument(
+        "--json", action="store_true", help="Emit the report as JSON."
+    )
+
     trace = sub.add_parser(
         "trace",
         help="Summarize a run's host span trace (trace.json; "
@@ -1216,6 +1441,8 @@ def main(argv: list[str] | None = None) -> int:
         "devices": cmd_devices,
         "watch": cmd_watch,
         "health": cmd_health,
+        "perf": cmd_perf,
+        "compare": cmd_compare,
         "trace": cmd_trace,
         "analyze": cmd_analyze,
         "eval": cmd_eval,

@@ -178,12 +178,21 @@ class CompileCache:
             return nullcontext()
         return self.tracer.span(name, **args)
 
-    def wrap(self, name: str, jit_fn, extra: str = "") -> "CachedProgram":
+    def wrap(
+        self, name: str, jit_fn, extra: str = "", cpu_aot: bool = True
+    ) -> "CachedProgram":
         """Wrap a jitted function in an AOT-caching dispatcher.
 
         `extra` carries a digest of everything that shapes the program
-        but is invisible in its input avals (use `config_digest`)."""
-        return CachedProgram(self, name, jit_fn, extra=extra)
+        but is invisible in its input avals (use `config_digest`).
+        `cpu_aot=False` bypasses the AOT path entirely on the CPU
+        backend (plain jit, no artifacts read or written): XLA:CPU
+        deserialization of the learner-step program family is broken in
+        this image — the reloaded executable runs without error and
+        returns the donated train state UNCHANGED (params silently stop
+        updating; reproduced deterministically, see rl/trainer.py).
+        Accelerator backends are unaffected by the flag."""
+        return CachedProgram(self, name, jit_fn, extra=extra, cpu_aot=cpu_aot)
 
     # --- keying -----------------------------------------------------------
 
@@ -366,14 +375,29 @@ class CachedProgram:
     """
 
     def __init__(
-        self, cache: CompileCache, name: str, jit_fn, extra: str = ""
+        self,
+        cache: CompileCache,
+        name: str,
+        jit_fn,
+        extra: str = "",
+        cpu_aot: bool = True,
     ) -> None:
         self._cache = cache
         self.name = name
         self._jit_fn = jit_fn
         self._extra = extra
+        self._cpu_aot = cpu_aot
         self._execs: dict[str, object] = {}
         self._lock = threading.Lock()
+
+    @property
+    def aot_active(self) -> bool:
+        """Whether this program uses the AOT artifact path here: the
+        cache is enabled AND the program is not CPU-bypassed (see
+        CompileCache.wrap's cpu_aot)."""
+        return self._cache.enabled and (
+            self._cpu_aot or jax.default_backend() != "cpu"
+        )
 
     def _executable_for(self, args):
         key = self._cache.signature(self.name, args, self._extra)
@@ -391,14 +415,15 @@ class CachedProgram:
     def warm(self, *args) -> bool:
         """Ensure an executable exists for these argument avals (no
         execution, no donation). True when an AOT executable is ready,
-        False when this program fell back to plain jit."""
-        if not self._cache.enabled:
+        False when this program fell back to plain jit (or is
+        CPU-bypassed)."""
+        if not self.aot_active:
             return False
         _, exe = self._executable_for(args)
         return exe is not _FALLBACK
 
     def __call__(self, *args):
-        if not self._cache.enabled:
+        if not self.aot_active:
             return self._jit_fn(*args)
         key, exe = self._executable_for(args)
         if exe is _FALLBACK:

@@ -35,6 +35,24 @@ class TelemetryConfig(BaseModel):
     # leading INTO the stall is on disk before anyone kills the process.
     FLUSH_TRACE_ON_STALL: bool = Field(default=True)
 
+    # --- metrics ledger (telemetry/ledger.py) ---
+    # Durable per-run timeseries: every processed metric batch and one
+    # derived utilization record per tick appended crash-safely to
+    # runs/<run>/metrics.jsonl (`cli perf` / `cli compare` read it).
+    LEDGER_ENABLED: bool = Field(default=True)
+    # Rotation: metrics.jsonl -> .1 -> .2 when a file crosses this size
+    # (0 disables rotation; the file then grows unbounded).
+    LEDGER_MAX_BYTES: int = Field(default=16 * 1024 * 1024, ge=0)
+    LEDGER_KEEP_ROTATIONS: int = Field(default=2, ge=0)
+    # fsync every append: maximally crash-durable, but a per-tick disk
+    # sync is unnecessary for observability — flush-on-close already
+    # survives process death; only a kernel crash loses the tail.
+    LEDGER_FSYNC: bool = Field(default=False)
+    # Opt-in Prometheus textfile exporter: the newest utilization
+    # record rendered as gauges into runs/<run>/metrics.prom (point a
+    # node_exporter textfile collector or any scraper at it).
+    PROMETHEUS_TEXTFILE: bool = Field(default=False)
+
     # --- anomaly detection ---
     ANOMALY_ENABLED: bool = Field(default=True)
     ANOMALY_EWMA_ALPHA: float = Field(default=0.02, gt=0, le=1.0)

@@ -186,12 +186,14 @@ def make_sp_attention(
     else:
         raise ValueError(f"Unknown sequence-parallel kind: {kind!r}")
 
-    sharded = jax.shard_map(
+    from .sharding import shard_map_compat
+
+    sharded = shard_map_compat(
         lambda q, k, v: inner(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        check=False,
     )
     dp_total = mesh.shape[dp_axis] if dp_axis is not None else 1
 

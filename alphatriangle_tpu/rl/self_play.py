@@ -38,6 +38,8 @@ TPU-native redesign (SURVEY.md §7 step 9):
 
 import functools
 import logging
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -260,6 +262,12 @@ class SelfPlayEngine:
         self._episodes_played = 0
         self._episodes_truncated = 0
         self._total_simulations = 0
+        # Cumulative host-blocking harvest-fetch seconds (the chunk's
+        # device_get — includes any wait for the chunk to finish, i.e.
+        # the host-visible round-trip cost telemetry/perf.py reports).
+        # Lock-guarded: producer threads fetch concurrently.
+        self.transfer_d2h_seconds = 0.0
+        self._transfer_lock = threading.Lock()
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
 
@@ -554,11 +562,15 @@ class SelfPlayEngine:
             jnp.int32(version),
         )
         payload: dict | None = None
+        t0 = time.perf_counter()
         if fetch_experiences:
             host = jax.device_get(outputs)  # the one transfer per chunk
         else:
             payload = {"mat": outputs.pop("mat"), "flush": outputs.pop("flush")}
             host = jax.device_get(outputs)  # stats + trace only (small)
+        dt = time.perf_counter() - t0
+        with self._transfer_lock:
+            self.transfer_d2h_seconds += dt
         # Under playout cap randomization the per-move sim count varies;
         # the trace records what actually ran.
         self._total_simulations += (

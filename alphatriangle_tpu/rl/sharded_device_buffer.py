@@ -110,8 +110,10 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         self._cursors = np.zeros(dp, dtype=np.int64)
         self._sizes = np.zeros(dp, dtype=np.int64)
 
+        from ..parallel.sharding import shard_map_compat
+
         self._ingest_jit = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 self._ingest_local,
                 mesh=mesh,
                 in_specs=(P(dp_axis), P(dp_axis), P(None, dp_axis)),

@@ -20,6 +20,7 @@ TPU-native redesign:
 """
 
 import logging
+import time
 from typing import Any
 
 import jax
@@ -38,6 +39,7 @@ from ..parallel.sharding import (
     local_rows,
     replicated,
     shard_batch,
+    shard_map_compat,
     state_shardings,
 )
 from ..utils.types import DenseBatch
@@ -170,6 +172,14 @@ class Trainer:
             self.mdl_axis = None
             self.tp_size = 1
         self.model = nn.model
+        # Host<->device transfer accounting (telemetry/perf.py reads
+        # deltas per tick): h2d = batch staging uploads; d2h = blocking
+        # result fetches (includes any wait for the step to finish —
+        # the host-visible cost of the round trip, which is what the
+        # utilization record is after). Single-writer (main/consumer
+        # thread), so bare float accumulation is safe.
+        self.transfer_h2d_seconds = 0.0
+        self.transfer_d2h_seconds = 0.0
         mc = nn.model_config
         self.num_atoms = mc.NUM_VALUE_ATOMS
         self.v_min, self.v_max = mc.VALUE_MIN, mc.VALUE_MAX
@@ -211,6 +221,15 @@ class Trainer:
         self._cache_extra = config_digest(
             train_config, nn.model_config, nn.env_config
         ) + f"|att{int(getattr(nn.model, 'attention_fn', None) is not None)}"
+        # cpu_aot=False on every learner program: XLA:CPU DESERIALIZED
+        # executables of this program family run without error but
+        # return the donated train state UNCHANGED — params silently
+        # stop updating. Reproduced deterministically (fresh compile
+        # updates params; the same process or a later one reloading the
+        # serialized artifact does not), while the rollout-chunk
+        # programs reload correctly. On CPU these programs therefore
+        # always compile fresh; accelerator backends keep the full AOT
+        # cache behavior.
         self._step_fn = cache.wrap(
             "learner_step",
             jax.jit(
@@ -220,6 +239,7 @@ class Trainer:
                 donate_argnums=(0,),
             ),
             extra=self._cache_extra,
+            cpu_aot=False,
         )
         # Fused multi-step: batches stacked on a new leading K axis, dp
         # sharding on axis 1; one compiled program per distinct K.
@@ -236,6 +256,7 @@ class Trainer:
                 donate_argnums=(0,),
             ),
             extra=self._cache_extra,
+            cpu_aot=False,
         )
         self._stacked_shard = stacked_shard
         # Device-buffer path (rl/device_buffer.py): batches are gathered
@@ -248,6 +269,7 @@ class Trainer:
             "learner_fused_from_ring",
             jax.jit(self._train_steps_from_impl, donate_argnums=(0,)),
             extra=self._cache_extra,
+            cpu_aot=False,
         )
         # dp-sharded ring variant (rl/sharded_device_buffer.py): built
         # lazily on first use, cached per shard geometry — the program
@@ -402,7 +424,7 @@ class Trainer:
                 local = idx_local - base  # global encoding -> local slot
                 return {k: v[local] for k, v in storage_local.items()}
 
-            gather = jax.shard_map(
+            gather = shard_map_compat(
                 gather_local,
                 mesh=self.mesh,
                 in_specs=(P(dp_axis), P(None, dp_axis)),
@@ -427,6 +449,7 @@ class Trainer:
                 f"learner_fused_from_sharded_ring/s{stride}_{dp_axis}",
                 jax.jit(impl, donate_argnums=(0,)),
                 extra=self._cache_extra,
+                cpu_aot=False,
             )
         return self._from_sharded_fns[key]
 
@@ -477,13 +500,17 @@ class Trainer:
             return None
         self._check_local_batch(n)
         batch = self._with_policy_weight(dict(batch), n)
+        t0 = time.perf_counter()
         device_batch = shard_batch(self.mesh, batch, self.dp_axis)
+        self.transfer_h2d_seconds += time.perf_counter() - t0
         self.state, metrics, td = self._step_fn(self.state, device_batch)
         # ONE blocking transfer for everything this step produced
         # (fetching each metric separately costs a round trip apiece).
+        t0 = time.perf_counter()
         host_metrics, td_host = jax.device_get(
             (metrics, td if jax.process_count() == 1 else None)
         )
+        self.transfer_d2h_seconds += time.perf_counter() - t0
         if td_host is None:
             td_host = local_rows(td)
         self._host_step += 1
@@ -534,10 +561,13 @@ class Trainer:
         if len(batches) == 1:
             # Single-step groups reuse the per-step program (a fused
             # K=1 program would recompile for nothing).
+            t0 = time.perf_counter()
             device_batch = shard_batch(self.mesh, batches[0], self.dp_axis)
+            self.transfer_h2d_seconds += time.perf_counter() - t0
             self.state, metrics, td = self._step_fn(self.state, device_batch)
             handle: dict = {"k": 1, "metrics": metrics, "td": td}
         else:
+            t0 = time.perf_counter()
             stacked_host = {
                 key: np.stack([np.asarray(b[key]) for b in batches])
                 for key in batches[0]
@@ -554,6 +584,7 @@ class Trainer:
                     lambda x: jax.device_put(x, self._stacked_shard),
                     stacked_host,
                 )
+            self.transfer_h2d_seconds += time.perf_counter() - t0
             self.state, metrics_k, td_k = self._multi_step_fn(
                 self.state, stacked
             )
@@ -624,9 +655,11 @@ class Trainer:
         """
         k = handle["k"]
         metrics_k, td_k = handle["metrics"], handle["td"]
+        t0 = time.perf_counter()
         host_metrics_k, td_host = jax.device_get(
             (metrics_k, td_k if jax.process_count() == 1 else None)
         )
+        self.transfer_d2h_seconds += time.perf_counter() - t0
         if td_host is None:
             td_host = local_rows(
                 td_k, axis=1 if (k > 1 or handle.get("stacked")) else 0
@@ -647,6 +680,13 @@ class Trainer:
         return results
 
     # --- AOT warming (compile_cache.py; cli warm) -------------------------
+
+    @property
+    def aot_enabled(self) -> bool:
+        """Whether the learner programs use the AOT artifact path on
+        this backend (False on CPU — see the cpu_aot note at the wrap
+        sites; `warm.py` reports those programs as skipped)."""
+        return self._step_fn.aot_active
 
     def _zero_batch(self, n: int) -> DenseBatch:
         """A dense batch of the training shapes, all zeros — enough to

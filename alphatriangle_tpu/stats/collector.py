@@ -14,6 +14,7 @@ from this environment; the writer degrades to TensorBoard-only
 (reference logs to both, `README.md:63-79`).
 """
 
+import atexit
 import json
 import logging
 import threading
@@ -110,6 +111,22 @@ class StatsCollector:
                     logger.exception(
                         "MLflow init failed; TensorBoard-only."
                     )
+        # Optional durable sink: called with (step, means) after every
+        # processed batch (telemetry.RunTelemetry.record_metrics wires
+        # the metrics ledger here in setup).
+        self._tick_sink = None
+        # Trailing sub-interval metrics used to be silently lost when
+        # a run shut down between ticks; close() now flushes pending
+        # events, and an atexit hook covers paths that never call
+        # close() (crash-adjacent teardown, forgotten cleanup).
+        self._last_event_step = 0
+        self._closed = False
+        self._atexit_cb = self.close
+        atexit.register(self._atexit_cb)
+
+    def set_tick_sink(self, sink) -> None:
+        """Attach a callable(step, means) invoked after each tick."""
+        self._tick_sink = sink
 
     # --- ingestion (cheap, any thread) ------------------------------------
 
@@ -130,6 +147,8 @@ class StatsCollector:
             return
         with self._lock:
             self._pending[event.name].append((event.global_step, event.value))
+            if event.global_step > self._last_event_step:
+                self._last_event_step = event.global_step
 
     def log_batch_events(self, events: list[RawMetricEvent]) -> None:
         for e in events:
@@ -187,6 +206,11 @@ class StatsCollector:
                 )
             except Exception:  # metrics are best-effort, never fatal
                 logger.exception("MLflow log_metrics failed")
+        if self._tick_sink is not None and means:
+            try:
+                self._tick_sink(global_step, means)
+            except Exception:  # durable sink is best-effort too
+                logger.exception("metrics tick sink failed")
         return means
 
     def force_process_and_log(self, global_step: int) -> dict[str, float]:
@@ -235,6 +259,24 @@ class StatsCollector:
             return dict(self._nonfinite)
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            atexit.unregister(self._atexit_cb)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+        # Final flush: events logged since the last tick (trailing
+        # sub-interval metrics) land at the newest step seen instead of
+        # silently evaporating with the process.
+        with self._lock:
+            has_pending = any(self._pending.values())
+            step = self._last_event_step
+        if has_pending:
+            try:
+                self.process_and_log(step)
+            except Exception:
+                logger.exception("final stats flush failed")
         if self._writer is not None:
             self._writer.close()
             self._writer = None

@@ -30,6 +30,9 @@ class WatchState:
     latest: dict = field(default_factory=dict)
     latest_step: int = 0
     latest_time: float = 0.0
+    # Newest utilization record from the metrics ledger
+    # (telemetry/perf.py): MFU, step time, transfer costs.
+    util: dict = field(default_factory=dict)
     # (wall time, step, cumulative episodes) samples for rate windows.
     _samples: deque = field(default_factory=lambda: deque(maxlen=512))
 
@@ -51,6 +54,22 @@ class WatchState:
         self._samples.append(
             (wall, step, means.get("Progress/Episodes_Played"))
         )
+        return True
+
+    def fold_util_line(self, line: str) -> bool:
+        """Fold one metrics-ledger line; only `kind: util` records are
+        kept (tick records duplicate live_metrics.jsonl). Returns False
+        for junk/torn/non-util lines."""
+        line = line.strip()
+        if not line:
+            return False
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return False
+        if not isinstance(rec, dict) or rec.get("kind") != "util":
+            return False
+        self.util = rec
         return True
 
     def _window(self) -> "tuple | None":
@@ -165,21 +184,30 @@ def render_frame(
         f"   producer restarts {_fmt(m.get('System/Producer_Restarts'), ',.0f')}"
         f"   full-search {_fmt(m.get('SelfPlay/Full_Search_Fraction'), ',.2f')}",
     ]
+    u = state.util
+    if u:
+        mfu = u.get("mfu")
+        lines.append(
+            f"  utilization  MFU {_fmt(mfu * 100 if mfu is not None else None, ',.2f', '%')}"
+            f"   {_fmt(u.get('tflops_per_sec'), ',.2f')} TFLOP/s"
+            f"   step {_fmt(u.get('step_time_ms'), ',.0f', 'ms')}"
+            f"   xfer h2d {_fmt(u.get('transfer_h2d_ms'), ',.0f', 'ms')}"
+            f" d2h {_fmt(u.get('transfer_d2h_ms'), ',.0f', 'ms')}"
+        )
     hline = health_line(health)
     if hline is not None:
         lines.append(hline)
     return "\n".join(lines)
 
 
-def tail_live_metrics(
-    path: Path,
-    state: WatchState,
-    offset: int = 0,
-) -> int:
-    """Fold lines appended past `offset`; returns the new offset.
+def tail_jsonl(path: Path, fold, offset: int = 0) -> int:
+    """Fold JSONL lines appended past `offset`; returns the new offset.
 
-    Tolerates the file not existing yet (run still compiling) and a
-    torn final line (reread next tick)."""
+    Tolerates the file not existing yet (run still compiling), a torn
+    final line (kept un-consumed and reread next tick — a line only
+    counts once its newline lands), junk bytes inside a line (the fold
+    callbacks reject them), and undecodable bytes (replaced, so a
+    partially-written multibyte character can't raise)."""
     try:
         size = path.stat().st_size
     except OSError:
@@ -187,16 +215,37 @@ def tail_live_metrics(
     if size <= offset:
         # Truncated (fresh run reusing the dir) — start over.
         return 0 if size < offset else offset
-    with path.open("r") as f:
-        f.seek(offset)
-        chunk = f.read()
+    try:
+        with path.open("r", errors="replace") as f:
+            f.seek(offset)
+            chunk = f.read()
+    except OSError:
+        return offset
     # Keep a torn trailing line for the next read.
     end = chunk.rfind("\n")
     if end < 0:
         return offset
     for line in chunk[: end + 1].splitlines():
-        state.fold_line(line)
+        fold(line)
     return offset + end + 1
+
+
+def tail_live_metrics(
+    path: Path,
+    state: WatchState,
+    offset: int = 0,
+) -> int:
+    """Fold `live_metrics.jsonl` ticks appended past `offset`."""
+    return tail_jsonl(path, state.fold_line, offset)
+
+
+def tail_ledger_utils(
+    path: Path,
+    state: WatchState,
+    offset: int = 0,
+) -> int:
+    """Fold `metrics.jsonl` utilization records appended past `offset`."""
+    return tail_jsonl(path, state.fold_util_line, offset)
 
 
 def find_latest_run_dir(runs_root: Path) -> "Path | None":

@@ -36,6 +36,15 @@ from .health import (
     health_verdict,
     read_health,
 )
+from .ledger import (
+    METRICS_FILENAME,
+    PROM_FILENAME,
+    MetricsLedger,
+    read_ledger,
+    tick_record,
+    write_prometheus_textfile,
+)
+from .perf import UtilizationMeter, summarize_utilization
 from .tracer import SpanTracer, summarize_trace_file
 
 logger = logging.getLogger(__name__)
@@ -44,14 +53,18 @@ __all__ = [
     "Anomaly",
     "AnomalyDetector",
     "HealthMonitor",
+    "MetricsLedger",
     "RunTelemetry",
     "SpanTracer",
     "TelemetryConfig",
+    "UtilizationMeter",
     "Watchdog",
     "dump_thread_stacks",
     "health_verdict",
     "read_health",
+    "read_ledger",
     "summarize_trace_file",
+    "summarize_utilization",
 ]
 
 TRACE_FILENAME = "trace.json"
@@ -77,10 +90,12 @@ class RunTelemetry:
         stats=None,
         run_name: str = "",
         clock=time.monotonic,
+        perf: UtilizationMeter | None = None,
     ) -> None:
         self.config = config or TelemetryConfig()
         self.run_dir = Path(run_dir)
         self.stats = stats
+        self.run_name = run_name
         enabled = self.config.ENABLED
         self.tracer = SpanTracer(
             capacity=self.config.SPAN_BUFFER_SIZE, enabled=enabled
@@ -98,6 +113,22 @@ class RunTelemetry:
             window=self.config.ANOMALY_WINDOW,
             entropy_floor=self.config.ENTROPY_COLLAPSE_THRESHOLD,
         )
+        # Durable metrics ledger + live utilization accounting (the
+        # persistence-and-analysis tier under the span/heartbeat
+        # surfaces; docs/OBSERVABILITY.md "Ledger").
+        self.perf = perf
+        self.ledger: MetricsLedger | None = None
+        if enabled and self.config.LEDGER_ENABLED:
+            self.ledger = MetricsLedger(
+                self.run_dir / METRICS_FILENAME,
+                max_bytes=self.config.LEDGER_MAX_BYTES,
+                keep=self.config.LEDGER_KEEP_ROTATIONS,
+                fsync=self.config.LEDGER_FSYNC,
+            )
+        if perf is not None:
+            self.health.set_device_info(
+                perf.device_kind, perf.peak_tflops, perf.peak_source
+            )
         self.watchdog: Watchdog | None = None
         if enabled and self.config.WATCHDOG_ENABLED:
             self.watchdog = Watchdog(
@@ -174,6 +205,45 @@ class RunTelemetry:
             if self.stats is not None:
                 self.stats.log_scalar(f"Anomaly/{a.kind}", 1.0, step)
         return anomalies
+
+    # --- metrics ledger (durable per-run timeseries) -------------------
+
+    def record_metrics(self, step: int, means: dict) -> None:
+        """Ledger one processed metric batch (the StatsCollector's tick
+        sink — wired in setup so EVERY flush lands, including the final
+        force flush and the collector's own close-time flush)."""
+        if self.ledger is not None and means:
+            self.ledger.append(tick_record(step, means))
+
+    def on_util_tick(self, step: int, **counters) -> "dict | None":
+        """Derive + persist one utilization record from the loop's
+        cumulative counters (see UtilizationMeter.tick for the keys).
+        Returns the record (tests, callers wanting the live numbers).
+        """
+        if not self.enabled or self.perf is None:
+            return None
+        if "compile_hits" not in counters:
+            try:
+                # Lazy: keeps this package importable without pulling
+                # jax into heartbeat/ledger READER processes.
+                from ..compile_cache import get_compile_cache
+
+                cc = get_compile_cache().stats()
+                counters["compile_hits"] = cc.get("hits", 0)
+                counters["compile_misses"] = cc.get("misses", 0)
+            except Exception:  # never let accounting hurt the loop
+                pass
+        record = self.perf.tick(step, **counters)
+        if record is None:
+            return None
+        if self.ledger is not None:
+            self.ledger.append(record)
+        self.health.note_utilization(record)
+        if self.config.PROMETHEUS_TEXTFILE:
+            write_prometheus_textfile(
+                self.run_dir / PROM_FILENAME, record, self.run_name
+            )
+        return record
 
     # --- per-iteration tick (the only heartbeat IO site) --------------
 

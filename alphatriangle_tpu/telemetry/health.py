@@ -85,6 +85,13 @@ class HealthMonitor:
         self._experiences = 0
         self._stalled = False
         self._stall_count = 0
+        # Device identity + live utilization (telemetry/perf.py): the
+        # heartbeat carries what the chip is and how hard it is being
+        # driven, so `cli health` answers "alive AND useful?".
+        self._device_kind: str | None = None
+        self._peak_tflops: float | None = None
+        self._peak_source: str | None = None
+        self._utilization: dict | None = None
 
     # --- beats (any thread, O(1)) -------------------------------------
 
@@ -102,6 +109,37 @@ class HealthMonitor:
     def note_buffer(self, size: int) -> None:
         with self._lock:
             self._buffer_size = size
+
+    def set_device_info(
+        self,
+        device_kind: str,
+        peak_tflops: float | None,
+        peak_source: str | None = None,
+    ) -> None:
+        with self._lock:
+            self._device_kind = device_kind
+            self._peak_tflops = peak_tflops
+            self._peak_source = peak_source
+
+    def note_utilization(self, record: dict) -> None:
+        """Latest derived utilization record (telemetry/perf.py); the
+        heartbeat carries a trimmed copy."""
+        keep = (
+            "step",
+            "learner_steps_per_sec",
+            "step_time_ms",
+            "moves_per_sec",
+            "games_per_hour",
+            "tflops_per_sec",
+            "mfu",
+            "buffer_fill",
+            "transfer_h2d_ms",
+            "transfer_d2h_ms",
+            "compile_cache_hit_rate",
+        )
+        trimmed = {k: record.get(k) for k in keep if k in record}
+        with self._lock:
+            self._utilization = trimmed
 
     def set_stalled(self, stalled: bool) -> None:
         with self._lock:
@@ -148,6 +186,10 @@ class HealthMonitor:
                 "stalled": self._stalled,
                 "stall_count": self._stall_count,
                 "watchdog_deadline_s": self.deadline_s,
+                "device_kind": self._device_kind,
+                "peak_bf16_tflops": self._peak_tflops,
+                "peak_source": self._peak_source,
+                "utilization": self._utilization,
                 "device_memory": device_memory_stats(),
             }
 

@@ -1,0 +1,249 @@
+"""Durable per-run metrics ledger: `metrics.jsonl` in the run dir.
+
+The StatsCollector's series live in memory and die with the process;
+TensorBoard event files need TensorBoard to read back; the five
+`BENCH_r0*.json` snapshots are the entire cross-run record. This module
+is the persistence tier under all of them: every processed metric batch
+and every derived utilization record (telemetry/perf.py) is appended as
+one JSON line to `runs/<run>/metrics.jsonl` — crash-safely, rotation-
+bounded, and readable by processes that never import JAX (`cli perf`,
+`cli compare`, `cli watch`, a rsync'd laptop shell).
+
+Crash-safety model (KataGo/Podracer-style continuous accounting needs
+the record to survive the run dying at ANY instant):
+
+- each `append` opens the file in append mode, writes ONE complete
+  line, flushes, and closes — there is no buffered state to lose and
+  no partially-interleaved writes from the single writer;
+- a crash mid-`write` leaves at most one torn final line, which every
+  reader here tolerates (skips) and the next append simply writes
+  after — the torn line stays as a scar, the ledger stays parseable;
+- rotation renames `metrics.jsonl` -> `.1` -> `.2` ... atomically
+  BETWEEN appends, so no record spans files.
+
+Readers (`read_ledger`, `iter_ledger_records`) walk rotations oldest
+first and skip unparseable lines instead of raising: a live writer, a
+torn tail, or a junk byte must never take down `cli watch`/`perf`.
+"""
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+METRICS_FILENAME = "metrics.jsonl"
+PROM_FILENAME = "metrics.prom"
+
+# Rotation defaults: ~16 MiB per file, 2 rotated generations kept. A
+# tick is a few hundred bytes, so this bounds the run dir at ~50 MiB of
+# ledger while still holding days of 1 Hz ticks.
+DEFAULT_MAX_BYTES = 16 * 1024 * 1024
+DEFAULT_KEEP = 2
+
+
+class MetricsLedger:
+    """Append-only JSONL writer with size-based rotation.
+
+    Stateless between appends (open/write/flush/close per record): the
+    single-writer training loop appends a few records per second at
+    most, and statelessness is what makes the crash story trivial —
+    there is never an open handle holding unflushed records.
+    """
+
+    def __init__(
+        self,
+        path: Path | str,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        keep: int = DEFAULT_KEEP,
+        fsync: bool = False,
+    ) -> None:
+        self.path = Path(path)
+        self.max_bytes = max_bytes
+        self.keep = keep
+        self.fsync = fsync
+        # First append of this process checks whether a previous
+        # process died mid-write and left a torn (newline-less) tail;
+        # if so the tail is terminated first, so OUR first record does
+        # not glue onto it and vanish with it.
+        self._tail_checked = False
+
+    def append(self, record: dict) -> bool:
+        """Append one record as a complete JSON line; True on success.
+
+        Failures are logged and swallowed — the ledger is observability,
+        never a reason to kill a training run.
+        """
+        try:
+            line = json.dumps(record, default=str) + "\n"
+        except (TypeError, ValueError):
+            logger.exception("ledger record not serializable; dropped")
+            return False
+        try:
+            self._maybe_rotate(len(line))
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            if not self._tail_checked:
+                self._tail_checked = True
+                if self._tail_is_torn():
+                    line = "\n" + line
+            with self.path.open("a") as f:
+                f.write(line)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            return True
+        except OSError:
+            logger.exception("ledger append to %s failed", self.path)
+            return False
+
+    def _tail_is_torn(self) -> bool:
+        """True when the file ends without a newline (a prior process
+        died mid-write). Checked once per process, not per append: a
+        single writer always leaves its own appends terminated."""
+        try:
+            with self.path.open("rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return False
+                f.seek(-1, os.SEEK_END)
+                return f.read(1) != b"\n"
+        except OSError:
+            return False
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Shift `metrics.jsonl` -> `.1` -> ... -> `.keep` when the next
+        append would cross `max_bytes`. Renames only — no record is
+        rewritten, so a crash between renames loses nothing."""
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        if self.keep <= 0:
+            self.path.unlink(missing_ok=True)
+            return
+        oldest = self.path.with_name(self.path.name + f".{self.keep}")
+        oldest.unlink(missing_ok=True)
+        for i in range(self.keep - 1, 0, -1):
+            src = self.path.with_name(self.path.name + f".{i}")
+            if src.exists():
+                src.replace(self.path.with_name(self.path.name + f".{i + 1}"))
+        self.path.replace(self.path.with_name(self.path.name + ".1"))
+
+    def close(self) -> None:
+        """No-op (no persistent handle); kept for lifecycle symmetry."""
+
+
+def ledger_paths(path: Path | str) -> list[Path]:
+    """Ledger files for `path`, oldest rotation first, live file last."""
+    path = Path(path)
+    rotated = []
+    i = 1
+    while True:
+        p = path.with_name(path.name + f".{i}")
+        if not p.exists():
+            break
+        rotated.append(p)
+        i += 1
+    out = list(reversed(rotated))
+    if path.exists():
+        out.append(path)
+    return out
+
+
+def iter_ledger_records(path: Path | str, kinds: "set[str] | None" = None):
+    """Yield parsed records across rotations, skipping torn/junk lines."""
+    for p in ledger_paths(path):
+        try:
+            with p.open("r", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn write / junk byte: skip, never raise
+                    if not isinstance(rec, dict):
+                        continue
+                    if kinds is not None and rec.get("kind") not in kinds:
+                        continue
+                    yield rec
+        except OSError:
+            continue
+
+
+def read_ledger(path: Path | str, kinds: "set[str] | None" = None) -> list[dict]:
+    """All parseable records (optionally filtered by `kind`), in order."""
+    return list(iter_ledger_records(path, kinds=kinds))
+
+
+def resolve_ledger_path(target: Path | str) -> "Path | None":
+    """Map a run dir / ledger file / arbitrary path to its ledger file."""
+    target = Path(target)
+    if target.is_dir():
+        target = target / METRICS_FILENAME
+    return target if target.exists() else None
+
+
+# --- Prometheus textfile export -----------------------------------------
+
+_PROM_HELP = {
+    "learner_steps_per_sec": "Learner SGD steps per second (tick window)",
+    "moves_per_sec": "Self-play experiences produced per second",
+    "games_per_hour": "Self-play episodes completed per hour",
+    "sims_per_sec": "MCTS simulations per second",
+    "step_time_ms": "Mean learner step time over the tick window, ms",
+    "tflops_per_sec": "Achieved model TFLOP/s (learner + self-play)",
+    "mfu": "Model FLOP/s utilization: achieved / peak bf16",
+    "buffer_fill": "Replay buffer occupancy fraction",
+    "buffer_size": "Replay buffer size, experiences",
+    "transfer_h2d_ms": "Host->device staging time this tick, ms",
+    "transfer_d2h_ms": "Device->host fetch time this tick, ms",
+    "compile_cache_hit_rate": "AOT executable cache hit rate so far",
+    "step": "Learner global step",
+}
+
+
+def write_prometheus_textfile(
+    path: Path | str, record: dict, run_name: str = ""
+) -> bool:
+    """Render one utilization record as Prometheus textfile gauges.
+
+    Atomic (tmp + replace) so a scraper never reads a half-written
+    exposition; numeric fields only, prefixed `alphatriangle_`.
+    """
+    path = Path(path)
+    label = f'{{run="{run_name}"}}' if run_name else ""
+    lines = []
+    for key, help_text in _PROM_HELP.items():
+        value = record.get(key)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        lines.append(f"# HELP alphatriangle_{key} {help_text}")
+        lines.append(f"# TYPE alphatriangle_{key} gauge")
+        lines.append(f"alphatriangle_{key}{label} {value}")
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text("\n".join(lines) + "\n")
+        tmp.replace(path)
+        return True
+    except OSError:
+        logger.exception("prometheus textfile write to %s failed", path)
+        return False
+
+
+def tick_record(step: int, means: dict, now: "float | None" = None) -> dict:
+    """The ledger line for one processed metric batch."""
+    return {
+        "kind": "tick",
+        "step": step,
+        "time": time.time() if now is None else now,
+        "means": means,
+    }

@@ -1,0 +1,368 @@
+"""Live utilization accounting + cross-run performance comparison.
+
+Before this module, MFU/FLOPs accounting ran only inside one-shot
+`bench.py` snapshots; a real training run reported throughput but never
+what fraction of the chip it used, and nothing could compare two runs.
+Podracer (arXiv:2104.06272) and KataGo (arXiv:1902.10565) both treat
+continuous utilization accounting as the steering signal for
+accelerator-RL work — this is that tier:
+
+- `UtilizationMeter` folds the training loop's cumulative counters
+  (learner steps, episodes, experiences, simulations, transfer time)
+  into one derived record per stats tick: steps/s, moves/s, games/h,
+  achieved TFLOP/s and MFU (analytic FLOPs from `utils/flops.py`),
+  buffer occupancy, host<->device transfer time, compile-cache hit
+  rate. Records land in the metrics ledger (`telemetry/ledger.py`),
+  the `health.json` heartbeat, and (opt-in) a Prometheus textfile.
+- `summarize_utilization` renders a run's util records into the
+  windowed summary `cli perf` prints (p50/p95 step time, MFU,
+  throughput trend).
+- `load_comparable` + `compare_summaries` align two runs (or a run
+  and a `BENCH_*.json` snapshot) metric-by-metric and report
+  regressions against a threshold — the CI/supervisor gate
+  `cli compare` exposes as exit codes.
+
+Nothing here imports JAX: every reader works beside a wedged chip.
+"""
+
+import json
+import logging
+import time
+from pathlib import Path
+
+from ..utils.flops import peak_bf16_tflops_info
+
+logger = logging.getLogger(__name__)
+
+SUMMARY_SCHEMA = "alphatriangle.perf.v1"
+
+# Metrics `cli compare` aligns between two runs, with direction
+# (True = higher is better; every current metric is a throughput).
+COMPARE_METRICS = (
+    "games_per_hour",
+    "moves_per_sec",
+    "learner_steps_per_sec",
+    "mfu",
+)
+
+
+class UtilizationMeter:
+    """Folds cumulative run counters into per-tick utilization records.
+
+    Counters arrive cumulative (the loop's own `episodes_played`-style
+    totals) so a missed tick never loses work — the next tick's delta
+    absorbs it. The first tick establishes the baseline and yields no
+    record.
+    """
+
+    def __init__(
+        self,
+        forward_flops: int = 0,
+        train_step_flops: int = 0,
+        device_kind: str = "",
+        buffer_capacity: int = 0,
+        clock=time.monotonic,
+    ) -> None:
+        self.forward_flops = int(forward_flops)
+        self.train_step_flops = int(train_step_flops)
+        self.device_kind = device_kind
+        self.buffer_capacity = int(buffer_capacity)
+        peak, source = peak_bf16_tflops_info(device_kind)
+        self.peak_tflops = peak
+        self.peak_source = source
+        self._clock = clock
+        self._prev: "dict | None" = None
+
+    def device_info(self) -> dict:
+        """Static device facts for `health.json` / summaries."""
+        return {
+            "device_kind": self.device_kind,
+            "peak_bf16_tflops": self.peak_tflops,
+            "peak_source": self.peak_source,
+        }
+
+    def tick(
+        self,
+        step: int,
+        episodes: int = 0,
+        experiences: int = 0,
+        simulations: int = 0,
+        buffer_size: int = 0,
+        transfer_h2d_s: float = 0.0,
+        transfer_d2h_s: float = 0.0,
+        compile_hits: int = 0,
+        compile_misses: int = 0,
+    ) -> "dict | None":
+        """One derived utilization record, or None (first/zero-width tick)."""
+        now = self._clock()
+        cur = {
+            "step": step,
+            "episodes": episodes,
+            "experiences": experiences,
+            "simulations": simulations,
+            "transfer_h2d_s": transfer_h2d_s,
+            "transfer_d2h_s": transfer_d2h_s,
+        }
+        prev, self._prev = self._prev, {"t": now, **cur}
+        if prev is None:
+            return None
+        dt = now - prev["t"]
+        if dt <= 0:
+            return None
+        d = {k: cur[k] - prev[k] for k in cur}
+        steps_s = max(0.0, d["step"]) / dt
+        moves_s = max(0.0, d["experiences"]) / dt
+        sims_s = max(0.0, d["simulations"]) / dt
+        # Achieved model FLOP/s: learner steps x analytic step FLOPs +
+        # self-play net evals (one per simulation leaf + ~one root eval
+        # per move; experiences/s approximates moves x lanes).
+        learner_fs = steps_s * self.train_step_flops
+        sp_fs = (sims_s + moves_s) * self.forward_flops
+        tflops = (learner_fs + sp_fs) / 1e12
+        mfu = (
+            tflops / self.peak_tflops
+            if self.peak_tflops and tflops > 0
+            else None
+        )
+        total_compiles = compile_hits + compile_misses
+        return {
+            "kind": "util",
+            "step": step,
+            "time": time.time(),
+            "window_s": round(dt, 3),
+            "learner_steps_per_sec": round(steps_s, 4),
+            "step_time_ms": (
+                round(1000.0 / steps_s, 3) if steps_s > 0 else None
+            ),
+            "moves_per_sec": round(moves_s, 2),
+            "games_per_hour": round(
+                max(0.0, d["episodes"]) * 3600.0 / dt, 2
+            ),
+            "sims_per_sec": round(sims_s, 1),
+            # 6+8 decimals: a test-sized net on CPU runs ~1e-6 TFLOP/s
+            # and must not round its MFU down to an ambiguous 0.0.
+            "tflops_per_sec": round(tflops, 6),
+            "mfu": round(mfu, 8) if mfu is not None else None,
+            "device_kind": self.device_kind,
+            "peak_bf16_tflops": self.peak_tflops,
+            "peak_source": self.peak_source,
+            "buffer_size": buffer_size,
+            "buffer_fill": (
+                round(buffer_size / self.buffer_capacity, 4)
+                if self.buffer_capacity
+                else None
+            ),
+            "transfer_h2d_ms": round(
+                max(0.0, d["transfer_h2d_s"]) * 1000.0, 2
+            ),
+            "transfer_d2h_ms": round(
+                max(0.0, d["transfer_d2h_s"]) * 1000.0, 2
+            ),
+            "compile_cache_hits": compile_hits,
+            "compile_cache_misses": compile_misses,
+            "compile_cache_hit_rate": (
+                round(compile_hits / total_compiles, 4)
+                if total_compiles
+                else None
+            ),
+        }
+
+
+# --- summaries ----------------------------------------------------------
+
+
+def _percentile(values: list, q: float) -> "float | None":
+    """Nearest-rank percentile; None for an empty list (no numpy — this
+    runs in JAX-free reader processes)."""
+    vals = sorted(v for v in values if isinstance(v, (int, float)))
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+    return float(vals[idx])
+
+
+def _mean(values: list) -> "float | None":
+    vals = [v for v in values if isinstance(v, (int, float))]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _trend(values: list) -> "float | None":
+    """Second-half mean over first-half mean, minus 1 (signed drift)."""
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if len(vals) < 4:
+        return None
+    half = len(vals) // 2
+    first, second = _mean(vals[:half]), _mean(vals[half:])
+    if not first:
+        return None
+    return second / first - 1.0
+
+
+def summarize_utilization(
+    records: list, window: "int | None" = None
+) -> "dict | None":
+    """Fold a run's util records into the `cli perf` summary.
+
+    `window` keeps only the newest N records (the whole run otherwise).
+    None when no usable records exist (schema failure for callers).
+    """
+    records = [
+        r
+        for r in records
+        if isinstance(r, dict) and r.get("kind") == "util"
+    ]
+    if not records:
+        return None
+    full_span = len(records)
+    if window is not None and window > 0:
+        records = records[-window:]
+
+    def col(key: str) -> list:
+        return [r.get(key) for r in records]
+
+    last = records[-1]
+    mfus = [v for v in col("mfu") if isinstance(v, (int, float))]
+    return {
+        "schema": SUMMARY_SCHEMA,
+        "ticks": len(records),
+        "ticks_total": full_span,
+        "first_step": records[0].get("step"),
+        "last_step": last.get("step"),
+        "wall_seconds": round(
+            sum(
+                r.get("window_s", 0.0)
+                for r in records
+                if isinstance(r.get("window_s"), (int, float))
+            ),
+            1,
+        ),
+        "device_kind": last.get("device_kind"),
+        "peak_bf16_tflops": last.get("peak_bf16_tflops"),
+        "peak_source": last.get("peak_source"),
+        "step_time_ms_p50": _percentile(col("step_time_ms"), 0.50),
+        "step_time_ms_p95": _percentile(col("step_time_ms"), 0.95),
+        "learner_steps_per_sec": _mean(col("learner_steps_per_sec")),
+        "moves_per_sec": _mean(col("moves_per_sec")),
+        "games_per_hour": _mean(col("games_per_hour")),
+        "sims_per_sec": _mean(col("sims_per_sec")),
+        "tflops_per_sec": _mean(col("tflops_per_sec")),
+        "mfu": _mean(mfus),
+        "mfu_max": max(mfus) if mfus else None,
+        "buffer_fill_last": last.get("buffer_fill"),
+        "transfer_h2d_ms": _mean(col("transfer_h2d_ms")),
+        "transfer_d2h_ms": _mean(col("transfer_d2h_ms")),
+        "compile_cache_hit_rate": last.get("compile_cache_hit_rate"),
+        "throughput_trend": _trend(
+            col("moves_per_sec")
+            if any(isinstance(v, (int, float)) and v > 0 for v in col("moves_per_sec"))
+            else col("learner_steps_per_sec")
+        ),
+    }
+
+
+# --- cross-run comparison ----------------------------------------------
+
+
+def _summary_from_bench(payload: dict, label: str) -> "dict | None":
+    """Normalize one `bench.py` JSON line into compare metrics."""
+    if payload.get("metric") != "self_play_games_per_hour":
+        return None
+    extra = payload.get("extra") or {}
+    flops = extra.get("flops") or {}
+    return {
+        "schema": SUMMARY_SCHEMA,
+        "source": label,
+        "games_per_hour": payload.get("value"),
+        "moves_per_sec": extra.get("moves_per_sec"),
+        "learner_steps_per_sec": (
+            extra.get("learner_steps_per_sec_fused")
+            or extra.get("learner_steps_per_sec")
+        ),
+        "mfu": flops.get("self_play_mfu"),
+        "device_kind": extra.get("device_kind"),
+    }
+
+
+def load_comparable(
+    target: str, root_dir: "str | None" = None
+) -> "tuple[dict | None, str]":
+    """(normalized summary, label) for one side of `cli compare`.
+
+    Accepts, in resolution order: a perf-summary JSON file (from
+    `cli perf --json`), a bench JSON line file (`BENCH_*.json`), a
+    `metrics.jsonl` path, a run directory, or a run name under the
+    runs root. Returns (None, reason) when nothing usable exists.
+    """
+    from .ledger import read_ledger, resolve_ledger_path
+
+    path = Path(target)
+    if path.is_file() and path.suffix == ".json":
+        try:
+            payload = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as exc:
+            return None, f"{target}: unreadable JSON ({exc})"
+        if isinstance(payload, dict):
+            if payload.get("schema") == SUMMARY_SCHEMA:
+                payload.setdefault("source", str(path))
+                return payload, str(path)
+            bench = _summary_from_bench(payload, str(path))
+            if bench is not None:
+                return bench, str(path)
+        return None, f"{target}: not a perf summary or bench JSON"
+    if path.exists():
+        ledger = resolve_ledger_path(path)
+    else:
+        run_dir = _run_dir_for(target, root_dir)
+        ledger = resolve_ledger_path(run_dir) if run_dir else None
+    if ledger is None:
+        return None, f"{target}: no metrics ledger found"
+    summary = summarize_utilization(read_ledger(ledger, kinds={"util"}))
+    if summary is None:
+        return None, f"{ledger}: no utilization records"
+    summary["source"] = str(ledger)
+    return summary, str(ledger)
+
+
+def _run_dir_for(run_name: str, root_dir: "str | None") -> "Path | None":
+    from ..config.persistence_config import PersistenceConfig
+
+    persistence = PersistenceConfig(RUN_NAME=run_name)
+    if root_dir:
+        persistence = persistence.model_copy(
+            update={"ROOT_DATA_DIR": root_dir}
+        )
+    run_dir = persistence.get_run_base_dir()
+    return run_dir if run_dir.is_dir() else None
+
+
+def compare_summaries(
+    a: dict, b: dict, threshold: float = 0.1
+) -> tuple[list, list]:
+    """(rows, regressions) comparing candidate `a` against baseline `b`.
+
+    A row is (metric, a_value, b_value, ratio, status); status is
+    "regression" when a < b * (1 - threshold), "improved" when
+    a > b * (1 + threshold), else "ok"; "n/a" when either side is
+    missing. `regressions` lists the regressed metric names.
+    """
+    rows = []
+    regressions = []
+    for metric in COMPARE_METRICS:
+        va, vb = a.get(metric), b.get(metric)
+        usable = all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in (va, vb)
+        )
+        if not usable or vb <= 0:
+            rows.append((metric, va, vb, None, "n/a"))
+            continue
+        ratio = va / vb
+        if ratio < 1.0 - threshold:
+            status = "regression"
+            regressions.append(metric)
+        elif ratio > 1.0 + threshold:
+            status = "improved"
+        else:
+            status = "ok"
+        rows.append((metric, va, vb, ratio, status))
+    return rows, regressions

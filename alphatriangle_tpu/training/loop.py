@@ -1038,12 +1038,43 @@ class TrainingLoop:
             if self._producer_error is not None:
                 raise self._producer_error
 
+    def _transfer_seconds(self) -> tuple[float, float]:
+        """Cumulative host<->device transfer seconds: (h2d, d2h).
+
+        h2d = the trainer's batch staging uploads; d2h = the trainer's
+        result fetches plus every rollout engine's harvest fetches
+        (engines are deduped — async streams include the primary)."""
+        c = self.c
+        h2d = float(getattr(c.trainer, "transfer_h2d_seconds", 0.0))
+        d2h = float(getattr(c.trainer, "transfer_d2h_seconds", 0.0))
+        engines = {id(c.self_play): c.self_play}
+        for rec in self._streams.values():
+            engine = rec.get("engine")
+            if engine is not None:
+                engines[id(engine)] = engine
+        d2h += sum(
+            float(getattr(e, "transfer_d2h_seconds", 0.0))
+            for e in engines.values()
+        )
+        return h2d, d2h
+
     def _iteration_tail(self) -> None:
         if self.cfg.PROFILE_WORKERS:
             for name, val in self.profile.timers.metrics().items():
                 self.c.stats.log_scalar(name, val, self.global_step)
-        # Heartbeat write (health.json) — before the stats tick so any
+        # Utilization record first (ledger + heartbeat fields), then the
+        # heartbeat write (health.json) — before the stats tick so any
         # Anomaly/* or Health/* events logged this iteration flush too.
+        h2d, d2h = self._transfer_seconds()
+        self.telemetry.on_util_tick(
+            self.global_step,
+            episodes=self.episodes_played,
+            experiences=self.experiences_added,
+            simulations=self.total_simulations,
+            buffer_size=len(self.c.buffer),
+            transfer_h2d_s=h2d,
+            transfer_d2h_s=d2h,
+        )
         self.telemetry.on_tick(self.global_step, len(self.c.buffer))
         self.c.stats.process_and_log(self.global_step)
         self._log_progress()

@@ -304,12 +304,40 @@ def setup_training_components(
         telemetry_config = telemetry_config.model_copy(
             update={"ENABLED": False}
         )
+    # Live MFU/throughput accounting (telemetry/perf.py): analytic
+    # FLOPs from the run's own model/env configs, peak from the device
+    # kind table or the ALPHATRIANGLE_PEAK_TFLOPS override. Feeds the
+    # metrics ledger, health.json and `cli watch`.
+    import jax
+
+    from ..telemetry.perf import UtilizationMeter
+    from ..utils.flops import forward_flops, train_step_flops
+
+    device = jax.devices()[0]
+    perf_meter = UtilizationMeter(
+        forward_flops=forward_flops(
+            model_config, env_config, env_config.action_dim
+        ),
+        train_step_flops=train_step_flops(
+            model_config,
+            env_config,
+            env_config.action_dim,
+            train_config.BATCH_SIZE,
+        ),
+        device_kind=str(getattr(device, "device_kind", device.platform)),
+        buffer_capacity=train_config.BUFFER_CAPACITY,
+    )
     telemetry = RunTelemetry(
         telemetry_config,
         run_dir=persistence_config.get_run_base_dir(),
         stats=stats,
         run_name=persistence_config.RUN_NAME,
+        perf=perf_meter,
     )
+    # Every processed metric batch is appended to the durable ledger —
+    # including the loop's final force flush and the collector's own
+    # close-time flush (docs/OBSERVABILITY.md "Ledger").
+    stats.set_tick_sink(telemetry.record_metrics)
     # Compile costs become `compile/<program>` spans in trace.json: the
     # AOT executable cache (compile_cache.py) reports every hit
     # (deserialize), miss (fresh compile) and serialize through the

@@ -18,8 +18,19 @@ Conventions:
   guessing.
 """
 
+import logging
+import os
+
 from ..config.env_config import EnvConfig
 from ..config.model_config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+# Operator-supplied peak override: lets CPU/smoke runs (and chips not
+# yet in the table) still produce an MFU ratio instead of null — the
+# denominator is then whatever the operator declares, recorded as
+# peak_source="env" wherever the number is published.
+PEAK_TFLOPS_ENV = "ALPHATRIANGLE_PEAK_TFLOPS"
 
 
 def _conv2d_flops(h: int, w: int, cin: int, cout: int, k: int, s: int) -> int:
@@ -115,11 +126,30 @@ _PEAK_BF16_TFLOPS = {
 }
 
 
-def peak_bf16_tflops(device_kind: str) -> float | None:
-    """Peak bf16 TFLOP/s for a `jax.Device.device_kind`, or None."""
+def peak_bf16_tflops_info(device_kind: str) -> tuple[float | None, str]:
+    """(peak bf16 TFLOP/s, source) for a `jax.Device.device_kind`.
+
+    Source is "env" (ALPHATRIANGLE_PEAK_TFLOPS override — wins so
+    operators can assert a denominator for unlisted chips or CPU
+    smokes), "table" (known chip), or "unknown" (peak None — an
+    explicit marker, never a guessed denominator).
+    """
+    override = os.environ.get(PEAK_TFLOPS_ENV, "").strip()
+    if override:
+        try:
+            value = float(override)
+            if value > 0:
+                return value, "env"
+            logger.warning(
+                "%s=%r is not positive; ignoring.", PEAK_TFLOPS_ENV, override
+            )
+        except ValueError:
+            logger.warning(
+                "%s=%r is not a number; ignoring.", PEAK_TFLOPS_ENV, override
+            )
     kind = (device_kind or "").strip()
     if kind in _PEAK_BF16_TFLOPS:
-        return _PEAK_BF16_TFLOPS[kind]
+        return _PEAK_BF16_TFLOPS[kind], "table"
     # Longest-prefix fallback, space-insensitive: device kinds vary
     # across runtime versions ("TPU v5 lite" vs "TPU v5litepod-8").
     norm = kind.lower().replace(" ", "")
@@ -128,7 +158,15 @@ def peak_bf16_tflops(device_kind: str) -> float | None:
         key = name.lower().replace(" ", "")
         if norm.startswith(key) and (best is None or len(key) > best[0]):
             best = (len(key), peak)
-    return best[1] if best else None
+    if best:
+        return best[1], "table"
+    return None, "unknown"
+
+
+def peak_bf16_tflops(device_kind: str) -> float | None:
+    """Peak bf16 TFLOP/s for a `jax.Device.device_kind`, or None
+    (honors the ALPHATRIANGLE_PEAK_TFLOPS override)."""
+    return peak_bf16_tflops_info(device_kind)[0]
 
 
 def mfu(achieved_flops_per_sec: float, device_kind: str) -> float | None:

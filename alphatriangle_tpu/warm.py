@@ -73,6 +73,12 @@ def warm_bench_programs(
     )
     trainer = Trainer(net, plan.train)
 
+    # Learner programs cannot AOT-cache on the CPU backend (reloaded
+    # executables return the donated train state unchanged — see the
+    # cpu_aot note in rl/trainer.py); report them as skipped instead of
+    # as failures so `cli warm cpu/smoke` still exits 0 when everything
+    # warmable is warm.
+    learner_fn = (lambda fn: fn) if trainer.aot_enabled else (lambda fn: None)
     targets: list[tuple[str, object]] = [
         (
             f"self_play_chunk/t{plan.chunk}",
@@ -80,18 +86,22 @@ def warm_bench_programs(
         ),
         (
             f"learner_step/b{plan.lbatch}",
-            lambda: trainer.warm_step(plan.lbatch),
+            learner_fn(lambda: trainer.warm_step(plan.lbatch)),
         ),
         (
             f"learner_fused/k{plan.fused_k}",
-            lambda: trainer.warm_steps(plan.fused_k, plan.lbatch),
+            learner_fn(
+                lambda: trainer.warm_steps(plan.fused_k, plan.lbatch)
+            ),
         ),
     ]
     if plan.overlap_k != plan.fused_k and not plan.device_replay:
         targets.append(
             (
                 f"learner_fused/k{plan.overlap_k}",
-                lambda: trainer.warm_steps(plan.overlap_k, plan.lbatch),
+                learner_fn(
+                    lambda: trainer.warm_steps(plan.overlap_k, plan.lbatch)
+                ),
             )
         )
     if plan.device_replay:
@@ -110,8 +120,10 @@ def warm_bench_programs(
         targets.append(
             (
                 f"learner_from_ring/k{plan.fused_k}",
-                lambda: trainer.warm_steps_from(
-                    dev_buffer, plan.fused_k, plan.lbatch
+                learner_fn(
+                    lambda: trainer.warm_steps_from(
+                        dev_buffer, plan.fused_k, plan.lbatch
+                    )
                 ),
             )
         )
@@ -119,8 +131,10 @@ def warm_bench_programs(
             targets.append(
                 (
                     f"learner_from_ring/k{plan.overlap_k}",
-                    lambda: trainer.warm_steps_from(
-                        dev_buffer, plan.overlap_k, plan.lbatch
+                    learner_fn(
+                        lambda: trainer.warm_steps_from(
+                            dev_buffer, plan.overlap_k, plan.lbatch
+                        )
                     ),
                 )
             )
@@ -133,12 +147,15 @@ def warm_bench_programs(
 
     def run_one(name: str, fn) -> dict:
         t0 = time.time()
-        try:
-            aot = bool(fn())
-            status = "aot" if aot else "jit-fallback"
-        except Exception as exc:  # a warm failure must not kill the rest
-            logger.exception("warm: %s failed", name)
-            status = f"error: {type(exc).__name__}: {exc}"
+        if fn is None:
+            status = "skipped-cpu"
+        else:
+            try:
+                aot = bool(fn())
+                status = "aot" if aot else "jit-fallback"
+            except Exception as exc:  # a warm failure must not kill the rest
+                logger.exception("warm: %s failed", name)
+                status = f"error: {type(exc).__name__}: {exc}"
         dt = time.time() - t0
         say(f"warm: {name}: {status} ({dt:.1f}s)")
         return {"program": name, "status": status, "seconds": round(dt, 1)}

@@ -331,11 +331,15 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     from alphatriangle_tpu.utils.flops import (
         forward_flops,
         mfu,
-        peak_bf16_tflops,
+        peak_bf16_tflops_info,
         train_step_flops,
     )
 
     device_kind = str(getattr(device, "device_kind", backend))
+    # Explicit "unknown" beats a null nobody can distinguish from a
+    # missing field; ALPHATRIANGLE_PEAK_TFLOPS (peak_source "env") lets
+    # CPU/smoke runs still publish an MFU ratio.
+    peak_tflops, peak_source = peak_bf16_tflops_info(device_kind)
     fwd = forward_flops(model_cfg, env_cfg, env_cfg.action_dim)
     sp_flops_s = leaf_evals_per_sec * fwd
     extra = {
@@ -363,7 +367,10 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         "device_kind": device_kind,
         "flops": {
             "forward_flops_per_eval": fwd,
-            "peak_bf16_tflops": peak_bf16_tflops(device_kind),
+            "peak_bf16_tflops": (
+                peak_tflops if peak_tflops is not None else "unknown"
+            ),
+            "peak_source": peak_source,
             "self_play_tflops_per_sec": round(sp_flops_s / 1e12, 3),
             "self_play_mfu": (
                 round(m, 4) if (m := mfu(sp_flops_s, device_kind)) else None

@@ -26,7 +26,9 @@ from pathlib import Path
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+# XLA:CPU persistent-cache RELOADS of donating programs silently return
+# unchanged outputs in this image (see tests/conftest.py) — a cached
+# learner step here would fake a flat learning curve; never enable it.
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))

@@ -1,0 +1,197 @@
+"""CI perf-smoke gate: short CPU training run -> `cli perf`/`cli compare`.
+
+`make perf-smoke` runs this. It proves, on any machine with no
+accelerator, that the metrics-ledger pipeline end to end still works:
+
+1. a tiny CPU training run (test-sized world, ~8 learner steps) writes
+   `metrics.jsonl` with utilization records (non-null MFU via the
+   ALPHATRIANGLE_PEAK_TFLOPS override this script sets);
+2. `cli perf <run>` summarizes it — exit 2 there means the ledger
+   schema broke;
+3. `cli compare <run> benchmarks/perf_reference_cpu_smoke.json`
+   gates against the checked-in reference summary. The threshold is
+   deliberately generous (default 0.9: fail only on a >90% collapse)
+   because CI hosts vary wildly in speed — the hard signal here is
+   schema alignment plus "not catastrophically slower", not a tight
+   perf bar (that's what `cli compare` against same-hardware runs is
+   for).
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise. Regenerate the reference with --write-reference after an
+intentional schema change.
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REFERENCE = Path(__file__).resolve().parent / "perf_reference_cpu_smoke.json"
+RUN_NAME = "perf_smoke"
+
+# Runnable as `python benchmarks/perf_smoke.py` without installing the
+# package: the repo root is the import root.
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+# Must precede any jax import: the smoke must not wake (or wedge on) an
+# accelerator, and the peak override is what makes CPU MFU non-null.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
+
+
+def tiny_configs():
+    """The test suite's tiny world (tests/conftest.py), inlined so the
+    smoke needs no pytest machinery."""
+    from alphatriangle_tpu.config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        ModelConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+
+    env_cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+        LINE_MIN_LENGTH=3,
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[4],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=0,
+        RESIDUAL_BLOCK_FILTERS=4,
+        USE_TRANSFORMER=False,
+        FC_DIMS_SHARED=[16],
+        POLICY_HEAD_DIMS=[16],
+        VALUE_HEAD_DIMS=[16],
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        NUM_VALUE_ATOMS=11,
+        COMPUTE_DTYPE="float32",
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=4, max_depth=4)
+    train_cfg = TrainConfig(
+        RUN_NAME=RUN_NAME,
+        AUTO_RESUME_LATEST=False,
+        MAX_TRAINING_STEPS=8,
+        SELF_PLAY_BATCH_SIZE=4,
+        ROLLOUT_CHUNK_MOVES=4,
+        BATCH_SIZE=8,
+        BUFFER_CAPACITY=2000,
+        MIN_BUFFER_SIZE_TO_TRAIN=16,
+        USE_PER=True,
+        PER_BETA_ANNEAL_STEPS=8,
+        N_STEP_RETURNS=2,
+        WORKER_UPDATE_FREQ_STEPS=2,
+        CHECKPOINT_SAVE_FREQ_STEPS=4,
+        MAX_EPISODE_MOVES=30,
+        RANDOM_SEED=5,
+        DEVICE="cpu",
+    )
+    return env_cfg, model_cfg, mcts_cfg, train_cfg
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.9,
+        help="compare tolerance vs the checked-in reference "
+        "(generous by design: CI hosts vary in speed).",
+    )
+    parser.add_argument(
+        "--root-dir",
+        default=None,
+        help="Runs root for the smoke run (default: a temp dir).",
+    )
+    parser.add_argument(
+        "--write-reference",
+        action="store_true",
+        help=f"Regenerate {REFERENCE.name} from this run's summary.",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from alphatriangle_tpu.cli import main as cli_main
+    from alphatriangle_tpu.config import PersistenceConfig
+    from alphatriangle_tpu.training import run_training
+
+    root = args.root_dir or tempfile.mkdtemp(prefix="at_perf_smoke_")
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_configs()
+    pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=RUN_NAME)
+    print(f"perf-smoke: training {RUN_NAME} under {root}...", flush=True)
+    rc = run_training(
+        train_config=train_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(f"perf-smoke: training run failed (rc={rc})", file=sys.stderr)
+        return rc
+
+    print("perf-smoke: cli perf (schema gate)...", flush=True)
+    rc = cli_main(["perf", RUN_NAME, "--root-dir", root])
+    if rc != 0:
+        print(f"perf-smoke: cli perf failed (rc={rc})", file=sys.stderr)
+        return rc
+
+    if args.write_reference:
+        import contextlib
+        import io
+        import json
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(["perf", RUN_NAME, "--root-dir", root, "--json"])
+        if rc != 0:
+            return rc
+        summary = json.loads(buf.getvalue())
+        summary["source"] = "benchmarks/perf_smoke.py --write-reference"
+        REFERENCE.write_text(json.dumps(summary, indent=2) + "\n")
+        print(f"perf-smoke: reference written to {REFERENCE}")
+        return 0
+
+    print(
+        f"perf-smoke: cli compare vs {REFERENCE.name} "
+        f"(threshold {args.threshold:.0%})...",
+        flush=True,
+    )
+    rc = cli_main(
+        [
+            "compare",
+            RUN_NAME,
+            str(REFERENCE),
+            "--root-dir",
+            root,
+            "--threshold",
+            str(args.threshold),
+        ]
+    )
+    if rc != 0:
+        print(f"perf-smoke: cli compare failed (rc={rc})", file=sys.stderr)
+        return rc
+    if args.root_dir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    print("perf-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,20 @@ sharding paths are exercised without TPU hardware.
 """
 
 import os
+import tempfile
+
+# AOT executable cache (compile_cache.py): point the process-wide cache
+# at a fresh per-run directory BEFORE any package import can build it.
+# Within one pytest process programs compile once and reuse in-memory
+# executables; what this prevents is DESERIALIZING artifacts a previous
+# process left behind — XLA:CPU reloads of the donating learner/rollout
+# programs can silently misbehave (see the persistent-cache note
+# below), and a stale shared /tmp cache made the suite's pass/fail
+# depend on what ran on the machine earlier. test_compile_cache builds
+# its own explicit cache dirs and is unaffected.
+os.environ["ALPHATRIANGLE_AOT_CACHE_DIR"] = tempfile.mkdtemp(
+    prefix="at_test_aot_"
+)
 
 # Must happen before jax import anywhere in the test process. Force CPU
 # even when the ambient environment points at a real accelerator (e.g.
@@ -26,11 +40,20 @@ import jax  # noqa: E402
 # re-assert CPU at the config layer before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 
-# The suite's runtime is dominated by jit compiles of near-identical
-# programs; the persistent compilation cache cuts repeat full-suite runs
-# by several minutes. Safe across processes (cache writes are atomic).
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Do NOT enable the XLA persistent compilation cache here. It used to
+# be on (jax_compilation_cache_dir=/tmp/jax_test_cache) to speed up
+# repeat suite runs, but XLA:CPU persistent-cache RELOADS are broken in
+# this image: a reloaded learner-step executable (donated train state)
+# runs without error and returns its inputs UNCHANGED — params stop
+# updating, silently (reproduced deterministically: cold run passes,
+# warm run fails test_params_change_and_metrics; and serializing the
+# reloaded executable fails with "Symbols not found"). This is the same
+# hazard utils/helpers.enable_persistent_compilation_cache documents
+# and guards by skipping the CPU backend — the test override bypassed
+# that guard. The repo's own AOT executable cache (compile_cache.py)
+# is unaffected (deserialize_executable round-trips correctly on CPU,
+# counter-proven in test_compile_cache) and keeps covering the
+# expensive programs across processes.
 
 # XLA:CPU's async dispatch can deadlock when one thread blocks on an
 # in-flight program while another enqueues programs sharing its buffers

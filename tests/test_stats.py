@@ -60,6 +60,46 @@ class TestCollector:
         assert "Stats/nonfinite_dropped" not in col.process_and_log(0)
         col.close()
 
+    def test_close_flushes_pending_events(self, tmp_path):
+        """Trailing sub-interval metrics must not be silently lost at
+        shutdown: close() runs a final process_and_log at the newest
+        step seen, and the tick sink receives it."""
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        sink_calls = []
+        col.set_tick_sink(lambda step, means: sink_calls.append((step, means)))
+        col.log_scalar("m", 1.0, step=3)
+        col.log_scalar("late", 9.0, step=7)  # never ticked
+        col.close()
+        assert col.latest("late") == 9.0
+        assert col.get_series("late") == [(7, 9.0)]
+        assert sink_calls and sink_calls[-1][0] == 7
+        assert sink_calls[-1][1]["late"] == 9.0
+        # Idempotent: a second close neither flushes nor raises.
+        n = len(sink_calls)
+        col.close()
+        assert len(sink_calls) == n
+
+    def test_tick_sink_receives_every_tick_and_never_raises(self, tmp_path):
+        col = StatsCollector(log_dir=tmp_path / "tb")
+
+        def bad_sink(step, means):
+            raise RuntimeError("sink down")
+
+        col.set_tick_sink(bad_sink)
+        col.log_scalar("m", 1.0, step=1)
+        # A failing sink must not break the tick.
+        assert col.process_and_log(1)["m"] == 1.0
+        col.close()
+
+    def test_atexit_registration_cleared_on_close(self, tmp_path):
+        import atexit
+
+        col = StatsCollector(log_dir=tmp_path / "tb")
+        col.close()
+        # Unregistered: atexit must not re-run close on a closed
+        # collector at interpreter exit (would resurrect the writer).
+        atexit.unregister(col._atexit_cb)  # no-op if already done
+
     def test_tensorboard_files_written(self, tmp_path):
         col = StatsCollector(log_dir=tmp_path / "tb")
         col.log_scalar("m", 1.0, 0)

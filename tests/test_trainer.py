@@ -128,6 +128,12 @@ class TestTrainStep:
         regularizer, which the reference keeps as an unweighted mean
         (`trainer.py:253-256`)."""
         trainer = Trainer(network, tiny_train_config)
+        # Step off the freshly-initialized params first: at init the
+        # policy is exactly uniform (entropy = ln(A), its maximum), a
+        # stationary point where the entropy gradient is mathematically
+        # ZERO — the zero-weight assertion below needs a non-degenerate
+        # policy to have anything to regularize.
+        assert trainer.train_step(make_batch()) is not None
         out = trainer.train_step(
             make_batch(weights=np.zeros(B, dtype=np.float32))
         )

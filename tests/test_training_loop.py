@@ -64,7 +64,11 @@ def build(tmp_path, cfgs, run_name="loop_run", **kw):
 
 
 class TestLoop:
-    def test_end_to_end_tiny_run(self, tmp_path, tiny_world_configs):
+    def test_end_to_end_tiny_run(self, tmp_path, tiny_world_configs, monkeypatch):
+        # Peak override: CPU has no table entry, so without it the
+        # utilization records would carry mfu null (acceptance bar:
+        # a smoke run produces a non-null MFU via the override).
+        monkeypatch.setenv("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
         c = build(tmp_path, tiny_world_configs)
         loop = TrainingLoop(c)
         status = loop.run()
@@ -89,6 +93,37 @@ class TestLoop:
             if p.is_dir()
         )
         assert 4 in steps and 8 in steps
+        # Metrics ledger (docs/OBSERVABILITY.md "Ledger"): the run dir
+        # holds a parseable metrics.jsonl whose tick records advance
+        # and whose utilization records carry a non-null MFU.
+        import json
+
+        ledger = c.persistence_config.get_run_base_dir() / "metrics.jsonl"
+        assert ledger.exists()
+        records = [
+            json.loads(line) for line in ledger.read_text().splitlines()
+        ]
+        ticks = [r for r in records if r["kind"] == "tick"]
+        utils = [r for r in records if r["kind"] == "util"]
+        assert ticks and utils
+        tick_steps = [r["step"] for r in ticks]
+        assert tick_steps == sorted(tick_steps)
+        assert tick_steps[-1] > tick_steps[0]  # the ledger advanced
+        assert any("Loss/total_loss" in r["means"] for r in ticks)
+        for r in utils:
+            assert r["mfu"] is not None
+            assert r["peak_source"] == "env"
+            assert r["learner_steps_per_sec"] >= 0
+        assert utils[-1]["step"] == 8
+        # Health heartbeat records the device identity + utilization.
+        health = json.loads(
+            (
+                c.persistence_config.get_run_base_dir() / "health.json"
+            ).read_text()
+        )
+        assert health["device_kind"] == "cpu"
+        assert health["peak_bf16_tflops"] == 1.0
+        assert health["utilization"] is not None
         c.stats.close()
         c.checkpoints.close()
 
