@@ -43,8 +43,10 @@ bench-smoke:
 	BENCH_SMOKE=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Metrics-ledger pipeline gate: a short CPU training run must produce a
-# parseable metrics.jsonl, `cli perf` must summarize it (exit 2 = the
-# ledger schema broke), and `cli compare` must hold against the
+# parseable metrics.jsonl carrying memory-attribution + live-memory
+# records, `cli perf` must summarize it (exit 2 = the ledger schema
+# broke), `cli fit cpu` must compose the static memory budget and exit
+# 0 (the OOM pre-flight gate), and `cli compare` must hold against the
 # checked-in reference summary (generous threshold — CI hosts vary in
 # speed; the hard signal is schema alignment + "not catastrophically
 # slower"). Regenerate the reference after intentional schema changes:

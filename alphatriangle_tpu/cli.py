@@ -429,10 +429,12 @@ def cmd_health(args: argparse.Namespace) -> int:
     for mem in payload.get("device_memory") or []:
         in_use = mem.get("bytes_in_use") or 0
         limit = mem.get("bytes_limit") or 0
+        peak = mem.get("peak_bytes_in_use") or 0
         pct = f" ({100.0 * in_use / limit:.0f}%)" if limit else ""
         print(
             f"  device {mem.get('device')} [{mem.get('kind')}]  "
             f"{in_use / 2**30:.2f} GiB in use"
+            + (f", peak {peak / 2**30:.2f} GiB" if peak else "")
             + (f" / {limit / 2**30:.2f} GiB{pct}" if limit else "")
         )
     return 0 if ok else 1
@@ -565,6 +567,17 @@ def cmd_perf(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 2
+    # Static memory budget rides the summary (compare gates it as
+    # `memory_budget_bytes` next to the observed peak).
+    mem_budget = None
+    mem_records = read_ledger(ledger, kinds={"memory"})
+    if mem_records:
+        from .telemetry.memory import compose_budget
+
+        budget = compose_budget(mem_records)
+        if budget["total_bytes"] > 0:
+            mem_budget = budget["total_bytes"]
+            summary["memory_budget_bytes"] = mem_budget
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -608,6 +621,16 @@ def cmd_perf(args: argparse.Namespace) -> int:
         f"   buffer fill {_fmt_cell(summary.get('buffer_fill_last'), ',.2f', 100.0, '%')}"
         f"   compile hits {_fmt_cell(summary.get('compile_cache_hit_rate'), ',.0f', 100.0, '%')}"
     )
+    mem_peak = summary.get("mem_peak_bytes_in_use")
+    if mem_peak is not None or mem_budget is not None:
+        from .telemetry.memory import fmt_bytes as _fmt_bytes
+
+        print(
+            f"  memory       peak {_fmt_bytes(mem_peak)}"
+            f"   in use {_fmt_bytes(summary.get('mem_bytes_in_use_last'))}"
+            f"   limit {_fmt_bytes(summary.get('mem_bytes_limit'))}"
+            f"   est budget {_fmt_bytes(mem_budget)} (cli mem)"
+        )
     print(
         f"  trend        {_fmt_cell(trend, '+,.1f', 100.0, '%')} "
         "(2nd-half vs 1st-half throughput)"
@@ -1115,6 +1138,206 @@ def cmd_warm(args: argparse.Namespace) -> int:
     return 0 if (ok and any(r["status"] == "aot" for r in rows)) else 1
 
 
+def cmd_fit(args: argparse.Namespace) -> int:
+    """OOM pre-flight gate (docs/OBSERVABILITY.md "Memory"): compose
+    the static per-device memory budget for a bench/preset scale —
+    train-state tree bytes + replay-ring bytes + AOT-analyzed program
+    memory (`compiled.memory_analysis()`, never executed) — and check
+    it against the device byte limit BEFORE a scarce accelerator
+    window is burned on an OOM. Exit 0 = fits, 1 = over budget, 2 =
+    no device limit known (set ALPHATRIANGLE_DEVICE_BYTES_LIMIT or
+    --limit-gb to assert one)."""
+    import json as _json
+    import os as _os
+
+    from .utils.helpers import enforce_platform
+
+    device = args.device or ("cpu" if args.target == "cpu" else "auto")
+    enforce_platform(device)
+
+    import jax
+
+    from .bench_config import resolve_bench_plan
+    from .telemetry.health import device_memory_stats
+    from .telemetry.memory import (
+        BYTES_LIMIT_ENV,
+        estimate_fit,
+        fit_verdict,
+        fmt_bytes,
+    )
+    from .utils.helpers import enable_persistent_compilation_cache
+
+    backend = jax.default_backend()
+    enable_persistent_compilation_cache(backend=backend)
+    environ = dict(_os.environ)
+    smoke = args.target == "smoke" or environ.get("BENCH_SMOKE") == "1"
+    if args.target and args.target.isdigit():
+        environ["BENCH_CONFIG"] = args.target
+    plan = resolve_bench_plan(smoke, backend, environ=environ)
+    print(
+        f"fit: backend={backend} scale={plan.scale} batch={plan.sp_batch} "
+        f"chunk={plan.chunk} lbatch={plan.lbatch} "
+        f"device_replay={plan.device_replay}",
+        file=sys.stderr,
+        flush=True,
+    )
+    report = estimate_fit(
+        plan.env,
+        plan.model,
+        plan.mcts,
+        plan.train,
+        fused_k=plan.fused_k,
+        device_replay=plan.device_replay,
+        progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+    )
+    budget = report["budget"]
+    # Per-device byte limit: explicit flag wins, then the env override,
+    # then the smallest limit any local device reports (conservative).
+    limit = None
+    source = "none"
+    override = environ.get(BYTES_LIMIT_ENV, "").strip()
+    if args.limit_gb is not None:
+        limit, source = args.limit_gb * 2**30, "flag"
+    elif override:
+        try:
+            limit, source = float(override), "env"
+        except ValueError:
+            print(
+                f"{BYTES_LIMIT_ENV}={override!r} is not a number; "
+                "ignoring.",
+                file=sys.stderr,
+            )
+    if limit is None:
+        limits = [
+            m.get("bytes_limit")
+            for m in device_memory_stats()
+            if isinstance(m.get("bytes_limit"), (int, float))
+            and m.get("bytes_limit") > 0
+        ]
+        if limits:
+            limit, source = min(limits), "device"
+    code, reason = fit_verdict(budget["total_bytes"], limit)
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "scale": plan.scale,
+                    "backend": backend,
+                    "budget": budget,
+                    "bytes_limit": limit,
+                    "limit_source": source,
+                    "exit": code,
+                    "reason": reason,
+                    "records": report["records"],
+                }
+            )
+        )
+        return code
+    print(f"fit {plan.scale} on {backend}")
+    for label, key in (
+        ("train state", "train_state_bytes"),
+        ("replay ring (device)", "replay_ring_bytes"),
+        ("rollout residency", "rollout_resident_bytes"),
+        ("program transient", "program_transient_bytes"),
+    ):
+        print(f"  {label:<22} {fmt_bytes(budget[key]):>12}")
+    print(f"  {'TOTAL (per device)':<22} {fmt_bytes(budget['total_bytes']):>12}")
+    print(
+        f"  limit                  {fmt_bytes(limit):>12}"
+        + (f"  [{source}]" if limit is not None else "")
+    )
+    print(reason)
+    return code
+
+
+def cmd_mem(args: argparse.Namespace) -> int:
+    """Memory-attribution table for a run, rendered from its artifacts
+    alone (`metrics.jsonl` `kind: "memory"` + `"util"` records) —
+    never imports JAX, safe beside a wedged chip. Exit 0 on a usable
+    table, 2 when the run has no memory records (predates the memory
+    ledger, or telemetry was disabled)."""
+    import json as _json
+
+    from .telemetry.ledger import read_ledger, resolve_ledger_path
+    from .telemetry.memory import (
+        attribution_rows,
+        compose_budget,
+        fmt_bytes,
+    )
+
+    target = Path(args.run) if args.run else None
+    if target is not None and target.exists():
+        ledger = resolve_ledger_path(target)
+    else:
+        run_dir = _resolve_run_dir(args.run, args.root_dir)
+        if run_dir is None:
+            return 2
+        ledger = resolve_ledger_path(run_dir)
+    if ledger is None:
+        print(f"no metrics ledger for {args.run}", file=sys.stderr)
+        return 2
+    records = read_ledger(ledger, kinds={"memory"})
+    utils = read_ledger(ledger, kinds={"util"})
+    observed = next(
+        (
+            u
+            for u in reversed(utils)
+            if isinstance(u.get("mem_bytes_in_use"), (int, float))
+        ),
+        None,
+    )
+    if not records and observed is None:
+        print(
+            f"{ledger}: no memory records (run predates the memory "
+            "ledger, or telemetry was disabled)",
+            file=sys.stderr,
+        )
+        return 2
+    budget = compose_budget(records)
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "source": str(ledger),
+                    "records": records,
+                    "budget": budget,
+                    "observed": observed,
+                }
+            )
+        )
+        return 0
+    print(f"mem {ledger}")
+    rows = attribution_rows(records)
+    if rows:
+        width = max(max(len(r[0]) for r in rows), 9)
+        print(f"  {'component':<{width}}  {'bytes':>12}  detail")
+        for component, total, detail in rows:
+            print(f"  {component:<{width}}  {fmt_bytes(total):>12}  {detail}")
+        print(
+            f"  static budget (per device): "
+            f"{fmt_bytes(budget['total_bytes'])} = "
+            f"state {fmt_bytes(budget['train_state_bytes'])}"
+            f" + ring {fmt_bytes(budget['replay_ring_bytes'])}"
+            f" + rollout {fmt_bytes(budget['rollout_resident_bytes'])}"
+            f" + transient {fmt_bytes(budget['program_transient_bytes'])}"
+        )
+    if observed is not None:
+        limit = observed.get("mem_bytes_limit")
+        util = observed.get("mem_utilization")
+        print(
+            f"  observed: {fmt_bytes(observed.get('mem_bytes_in_use'))} "
+            f"in use, peak {fmt_bytes(observed.get('mem_peak_bytes_in_use'))}"
+            + (
+                f", limit {fmt_bytes(limit)}"
+                + (f" ({util:.1%} used)" if isinstance(util, (int, float)) else "")
+                if limit
+                else ""
+            )
+            + f" (step {observed.get('step')})"
+        )
+    return 0
+
+
 def cmd_tune(args: argparse.Namespace) -> int:
     """On-hardware self-play shape autotuner.
 
@@ -1402,6 +1625,55 @@ def main(argv: list[str] | None = None) -> int:
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
 
+    fit = sub.add_parser(
+        "fit",
+        help="OOM pre-flight: compose the static per-device memory "
+        "budget (params + opt state + replay ring + AOT-analyzed "
+        "program memory) against the device byte limit; exit 0 fits / "
+        "1 over budget / 2 unknown device limit.",
+    )
+    fit.add_argument(
+        "target",
+        nargs="?",
+        default="auto",
+        choices=["auto", "smoke", "cpu", "1", "2", "3", "4", "5"],
+        help="Scale to check: 'auto' = the bench scale for this "
+        "backend (honors ambient BENCH_* knobs), 'smoke'/'cpu' = the "
+        "reduced scales, 1..5 = a BASELINE preset.",
+    )
+    fit.add_argument(
+        "--limit-gb",
+        type=float,
+        default=None,
+        metavar="GIB",
+        help="Assert a per-device byte limit (GiB) instead of asking "
+        "the backend (also: ALPHATRIANGLE_DEVICE_BYTES_LIMIT, bytes).",
+    )
+    fit.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+    fit.add_argument(
+        "--json", action="store_true", help="Emit the report as JSON."
+    )
+
+    mem = sub.add_parser(
+        "mem",
+        help="Memory-attribution table for a run (programs, train "
+        "state, replay ring, observed in-use/peak) from its "
+        "metrics.jsonl alone — no JAX import.",
+    )
+    mem.add_argument(
+        "run",
+        nargs="?",
+        default=None,
+        help="Run name, run dir, or metrics.jsonl path "
+        "(default: latest run).",
+    )
+    mem.add_argument("--root-dir", default=None)
+    mem.add_argument(
+        "--json", action="store_true", help="Emit records + budget as JSON."
+    )
+
     tune = sub.add_parser(
         "tune",
         help="Sweep self-play batch/chunk shapes on this hardware and "
@@ -1449,6 +1721,8 @@ def main(argv: list[str] | None = None) -> int:
         "play": cmd_play,
         "tune": cmd_tune,
         "warm": cmd_warm,
+        "fit": cmd_fit,
+        "mem": cmd_mem,
     }
     return handlers[args.command](args)
 

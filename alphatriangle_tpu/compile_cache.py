@@ -165,6 +165,12 @@ class CompileCache:
         self.exec_errors = 0
         # name -> {"event": hit|miss|..., "seconds": float}
         self.events: list[dict] = []
+        # "name:key" -> memory record (telemetry/memory.py): every
+        # program's AOT memory_analysis, captured at compile/reload
+        # time and persisted beside the executable artifact. Runs pull
+        # from this registry (RunTelemetry keeps its own seen-set, so
+        # several runs in one process each ledger every record once).
+        self.memory_records: dict[str, dict] = {}
 
     # --- wiring -----------------------------------------------------------
 
@@ -221,6 +227,79 @@ class CompileCache:
         safe = name.replace("/", "_").replace(" ", "_")
         return self.cache_dir / f"{safe}-{key}.jaxexe"
 
+    # --- memory attribution (telemetry/memory.py; docs/OBSERVABILITY.md) --
+
+    def memory_record_for(self, name: str, key: str) -> "dict | None":
+        with self._lock:
+            return self.memory_records.get(f"{name}:{key}")
+
+    def _register_memory(self, name: str, key: str, record: dict) -> None:
+        with self._lock:
+            self.memory_records.setdefault(f"{name}:{key}", record)
+
+    def capture_memory(
+        self, name: str, key: str, compiled, persist: bool = True
+    ) -> "dict | None":
+        """Record `compiled.memory_analysis()` for one program and (by
+        default) persist it as a `.mem.json` sidecar beside the
+        executable artifact, so `cli mem` can attribute a run's HBM
+        without recompiling anything. Never raises — attribution can
+        only ever add visibility, never break a compile."""
+        existing = self.memory_record_for(name, key)
+        if existing is not None:
+            return existing
+        try:
+            from .telemetry.memory import program_memory_record
+
+            record = program_memory_record(
+                name,
+                compiled,
+                backend=jax.default_backend(),
+                key=key,
+            )
+        except Exception:
+            return None
+        if record is None:
+            return None
+        self._register_memory(name, key, record)
+        if persist:
+            try:
+                import json
+
+                sidecar = self._path(name, key).with_suffix(".mem.json")
+                sidecar.parent.mkdir(parents=True, exist_ok=True)
+                tmp = sidecar.with_suffix(f".tmp{os.getpid()}")
+                tmp.write_text(json.dumps(record))
+                tmp.replace(sidecar)
+            except OSError:
+                logger.debug(
+                    "compile_cache: %s memory sidecar write failed", name
+                )
+        return record
+
+    def _load_memory_sidecar(self, name: str, key: str) -> "dict | None":
+        """Reload a previously persisted memory record on an AOT hit
+        (the analysis also works on deserialized executables — the
+        sidecar just makes the record survive artifact sharing)."""
+        try:
+            import json
+
+            sidecar = self._path(name, key).with_suffix(".mem.json")
+            record = json.loads(sidecar.read_text())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(record, dict) or record.get("kind") != "memory":
+            return None
+        record["origin"] = "sidecar"
+        self._register_memory(name, key, record)
+        return record
+
+    def memory_summary(self) -> list[dict]:
+        """Every program memory record this process captured (the bench
+        JSON's `extra.memory.programs` block)."""
+        with self._lock:
+            return list(self.memory_records.values())
+
     # --- load / compile / serialize ---------------------------------------
 
     def load_or_compile(self, name: str, key: str, jit_fn, args):
@@ -243,6 +322,10 @@ class CompileCache:
                     )
                 dt = time.time() - t0
                 self._note("hit", name, dt)
+                # Attribution rides the hit too: prefer the persisted
+                # sidecar, fall back to analyzing the reloaded program.
+                if self._load_memory_sidecar(name, key) is None:
+                    self.capture_memory(name, key, compiled)
                 logger.info(
                     "compile_cache: %s HIT (%s, deserialized in %.2fs)",
                     name,
@@ -278,6 +361,7 @@ class CompileCache:
         dt = time.time() - t0
         self._note("miss", name, dt)
         logger.info("compile_cache: %s MISS (compiled in %.2fs)", name, dt)
+        self.capture_memory(name, key, compiled)
         self._serialize(name, path, compiled)
         return compiled
 
@@ -421,6 +505,39 @@ class CachedProgram:
             return False
         _, exe = self._executable_for(args)
         return exe is not _FALLBACK
+
+    def analyze(self, *args) -> "dict | None":
+        """Memory record for this program at these argument avals
+        (telemetry/memory.py), compiling AOT if needed — WITHOUT
+        executing anything. Works even for CPU-bypassed programs
+        (cpu_aot=False guards *deserialization*; a fresh lower+compile
+        purely for `memory_analysis()` is safe and is not serialized).
+        None when the program can't lower or the backend reports no
+        analysis. This is `cli fit`'s estimator entry point."""
+        key = self._cache.signature(self.name, args, self._extra)
+        record = self._cache.memory_record_for(self.name, key)
+        if record is not None:
+            return record
+        if self.aot_active:
+            _, exe = self._executable_for(args)
+            if exe is not _FALLBACK:
+                record = self._cache.memory_record_for(self.name, key)
+                if record is not None:
+                    return record
+                return self._cache.capture_memory(self.name, key, exe)
+        try:
+            compiled = self._jit_fn.lower(*args).compile()
+        except Exception as exc:
+            logger.warning(
+                "compile_cache: %s memory analysis lower/compile failed "
+                "(%s)",
+                self.name,
+                _exc_brief(exc),
+            )
+            return None
+        return self._cache.capture_memory(
+            self.name, key, compiled, persist=False
+        )
 
     def __call__(self, *args):
         if not self.aot_active:

@@ -18,12 +18,16 @@ Axes:
 """
 
 import math
-from typing import Literal
+from typing import TYPE_CHECKING, Literal
 
-import jax
-import numpy as np
-from jax.sharding import Mesh
 from pydantic import BaseModel, Field
+
+if TYPE_CHECKING:  # JAX is imported lazily inside the mesh builders:
+    # this module rides the config package, which every JAX-free reader
+    # process (`cli perf/mem/watch/health` beside a wedged chip)
+    # imports — a module-level jax import here would drag the whole
+    # runtime into them.
+    from jax.sharding import Mesh
 
 
 class MeshConfig(BaseModel):
@@ -52,8 +56,12 @@ class MeshConfig(BaseModel):
             return n_devices // other
         return self.DP_SIZE
 
-    def build_mesh(self, devices: list | None = None) -> Mesh:
+    def build_mesh(self, devices: list | None = None) -> "Mesh":
         """Construct the (dp, mdl, sp) mesh over the available devices."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
         if devices is None:
             devices = (
                 jax.devices()
@@ -73,8 +81,12 @@ class MeshConfig(BaseModel):
         return Mesh(grid, (self.DP_AXIS, self.MDL_AXIS, self.SP_AXIS))
 
     @staticmethod
-    def single_device_mesh() -> Mesh:
+    def single_device_mesh() -> "Mesh":
         """A 1x1x1 mesh on the default device (works everywhere)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
         dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1)
         return Mesh(dev, ("dp", "mdl", "sp"))
 
@@ -85,7 +97,7 @@ def largest_pow2_leq(n: int) -> int:
 
 
 def rollout_lane_axes(
-    mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"
+    mesh: "Mesh", dp_axis: str = "dp", sp_axis: str = "sp"
 ) -> tuple:
     """Mesh axes the self-play lockstep lanes shard over.
 
@@ -101,7 +113,7 @@ def rollout_lane_axes(
     return (dp_axis,)
 
 
-def lane_shard_count(mesh: Mesh, axes: tuple) -> int:
+def lane_shard_count(mesh: "Mesh", axes: tuple) -> int:
     """How many ways the lane dim splits over `axes` of `mesh`."""
     n = 1
     for ax in axes:

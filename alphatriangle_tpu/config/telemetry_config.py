@@ -62,5 +62,13 @@ class TelemetryConfig(BaseModel):
     # Policy entropy at/below this after warmup counts as a collapse.
     ENTROPY_COLLAPSE_THRESHOLD: float = Field(default=0.01, ge=0)
 
+    # --- memory observability (telemetry/memory.py) ---
+    # Leak detector (`Anomaly/memory_growth`): device bytes_in_use
+    # rising MONOTONICALLY for this many utilization ticks, with total
+    # growth over the run of at least this fraction, fires once per
+    # excursion (a healthy allocator sawtooths; a leak only climbs).
+    MEMORY_GROWTH_TICKS: int = Field(default=12, ge=2)
+    MEMORY_GROWTH_MIN_FRACTION: float = Field(default=0.05, ge=0)
+
 
 TelemetryConfig.model_rebuild(force=True)

@@ -260,6 +260,24 @@ class DeviceReplayBuffer(ExperienceBuffer):
             )
         return slots.astype(np.int64)
 
+    # --- memory attribution (telemetry/memory.py) -------------------------
+
+    def storage_nbytes(self) -> int:
+        """Exact bytes of the device-resident ring storage (dtype/shape
+        math over the allocated arrays; equals
+        `telemetry.memory.replay_ring_bytes` for this geometry)."""
+        from ..telemetry.memory import tree_bytes
+
+        return tree_bytes(self.storage)
+
+    def memory_record(self) -> dict:
+        """This ring's `kind: "memory"` ledger record (HBM-resident)."""
+        from ..telemetry.memory import replay_ring_record
+
+        return replay_ring_record(
+            self.storage_nbytes(), self.capacity, shards=1, location="device"
+        )
+
     # --- sampling ---------------------------------------------------------
 
     def sample(

@@ -647,6 +647,18 @@ class SelfPlayEngine:
             jnp.int32(version),
         )
 
+    def analyze_chunk(self, num_moves: int | None = None) -> "dict | None":
+        """Memory record of the rollout chunk program at this engine's
+        real dispatch avals (telemetry/memory.py) — AOT analysis only,
+        nothing executes and the carry is untouched (`cli fit`)."""
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        version = self.net.weights_version
+        return self._chunk_fn(t).analyze(
+            self._place_variables(self.net.variables, version),
+            self._carry,
+            jnp.int32(version),
+        )
+
     def play_move(self) -> None:
         """Advance every game by one move (single-move chunk)."""
         self.play_chunk(1)

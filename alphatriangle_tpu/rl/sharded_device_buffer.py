@@ -197,6 +197,27 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         shard; only the per-shard counts come back."""
         return self._ingest_blocks((payload["mat"], payload["flush"]))[0]
 
+    # --- memory attribution (telemetry/memory.py) -------------------------
+
+    def storage_nbytes(self) -> int:
+        """Exact bytes of the sharded ring storage across all dp shards
+        (dtype/shape math; `storage_nbytes() // dp` is the per-device
+        HBM the ring occupies)."""
+        from ..telemetry.memory import tree_bytes
+
+        return tree_bytes(self.storage)
+
+    def memory_record(self) -> dict:
+        """This ring's `kind: "memory"` ledger record (dp-sharded)."""
+        from ..telemetry.memory import replay_ring_record
+
+        return replay_ring_record(
+            self.storage_nbytes(),
+            self.capacity,
+            shards=self.dp,
+            location="device",
+        )
+
     def add_dense(
         self,
         grid: np.ndarray,

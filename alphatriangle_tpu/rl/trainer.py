@@ -742,6 +742,44 @@ class Trainer:
         )
         return from_fn.warm(self.state, buffer.storage, idx, weights)
 
+    # --- memory attribution (telemetry/memory.py; cli fit) ----------------
+
+    def analyze_step(self, batch_size: int | None = None) -> "dict | None":
+        """Memory record of the per-step learner program (AOT-lowered,
+        never executed — works on CPU despite the cpu_aot bypass)."""
+        b = batch_size or self.config.BATCH_SIZE
+        device_batch = shard_batch(
+            self.mesh, self._zero_batch(b), self.dp_axis
+        )
+        return self._step_fn.analyze(self.state, device_batch)
+
+    def analyze_steps(
+        self, k: int, batch_size: int | None = None
+    ) -> "dict | None":
+        """Memory record of the K-fused learner program."""
+        b = batch_size or self.config.BATCH_SIZE
+        batch = self._zero_batch(b)
+        stacked_host = {key: np.stack([batch[key]] * k) for key in batch}
+        stacked = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._stacked_shard), stacked_host
+        )
+        return self._multi_step_fn.analyze(self.state, stacked)
+
+    def analyze_steps_from(
+        self, buffer, k: int, batch_size: int | None = None
+    ) -> "dict | None":
+        """Memory record of the device-replay fused gather program
+        (needs a real ring — its storage IS an argument)."""
+        b = batch_size or self.config.BATCH_SIZE
+        idx = np.zeros((k, b), np.int32)
+        weights = np.ones((k, b), np.float32)
+        from_fn = (
+            self._get_from_sharded_fn(buffer)
+            if getattr(buffer, "is_sharded", False)
+            else self._from_fn
+        )
+        return from_fn.analyze(self.state, buffer.storage, idx, weights)
+
     @property
     def global_step(self) -> int:
         return self._host_step

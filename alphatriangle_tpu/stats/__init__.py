@@ -7,11 +7,30 @@ in-process `StatsCollector` (lock-guarded event sink -> TensorBoard on
 (jax-pytree train state + dense buffer spill + auto-resume) — no actor
 runtime required, and checkpoints are standard Orbax trees any JAX tool
 can read.
+
+The persistence re-exports resolve lazily (PEP 562): `CheckpointManager`
+drags in Orbax (and with it JAX), but this package also hosts
+`stats/watch.py`, which JAX-free reader processes (`cli watch/mem/...`
+beside a wedged chip) import through here.
 """
 
 from .collector import StatsCollector
 from .events import RawMetricEvent
-from .persistence import CheckpointManager, LoadedTrainingState
+
+_PERSISTENCE_EXPORTS = frozenset(
+    {"CheckpointManager", "LoadedTrainingState"}
+)
+
+
+def __getattr__(name: str):
+    if name in _PERSISTENCE_EXPORTS:
+        from . import persistence
+
+        return getattr(persistence, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 __all__ = [
     "CheckpointManager",

@@ -154,6 +154,30 @@ def health_line(health: "dict | None", now: "float | None" = None) -> "str | Non
     )
 
 
+def _gib(value: "float | None") -> str:
+    if not isinstance(value, (int, float)):
+        return "—"
+    return f"{value / 2**30:,.2f} GiB"
+
+
+def memory_line(util: dict) -> "str | None":
+    """Render the newest utilization record's device-memory fields
+    (telemetry/memory.py: in-use / run-peak / % of limit) as one watch
+    line; None when the record predates memory accounting."""
+    in_use = util.get("mem_bytes_in_use")
+    if not isinstance(in_use, (int, float)):
+        return None
+    peak = util.get("mem_peak_bytes_in_use")
+    limit = util.get("mem_bytes_limit")
+    pct = util.get("mem_utilization")
+    line = f"  memory       {_gib(in_use)} in use   peak {_gib(peak)}"
+    if isinstance(limit, (int, float)) and limit:
+        line += f"   limit {_gib(limit)}"
+        if isinstance(pct, (int, float)):
+            line += f" ({pct:.1%})"
+    return line
+
+
 def render_frame(
     state: WatchState, run_name: str, health: "dict | None" = None
 ) -> str:
@@ -194,6 +218,9 @@ def render_frame(
             f"   xfer h2d {_fmt(u.get('transfer_h2d_ms'), ',.0f', 'ms')}"
             f" d2h {_fmt(u.get('transfer_d2h_ms'), ',.0f', 'ms')}"
         )
+        mline = memory_line(u)
+        if mline is not None:
+            lines.append(mline)
     hline = health_line(health)
     if hline is not None:
         lines.append(hline)

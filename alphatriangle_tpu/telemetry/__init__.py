@@ -15,7 +15,13 @@ to:
 - `anomaly.AnomalyDetector` — streaming EWMA/z-score checks over
   per-step training metrics (loss spikes, grad-norm explosions,
   non-finite values, policy-entropy collapse) escalated to `Anomaly/*`
-  metrics and warnings with recent-window context.
+  metrics and warnings with recent-window context; plus a
+  monotonic-growth memory leak detector (`Anomaly/memory_growth`) fed
+  per utilization tick.
+- `memory` — per-program HBM attribution (AOT `memory_analysis()`
+  capture via compile_cache), train-state/replay-ring byte accounting,
+  and the static pre-flight budget behind `cli fit`/`cli mem`
+  (docs/OBSERVABILITY.md "Memory").
 
 Podracer-style stacks (arXiv:2104.06272) treat this visibility as a
 prerequisite for scaling an async producer/learner loop; the repo's own
@@ -44,6 +50,18 @@ from .ledger import (
     tick_record,
     write_prometheus_textfile,
 )
+from .memory import (
+    attribution_rows,
+    compose_budget,
+    estimate_fit,
+    fit_verdict,
+    program_memory_record,
+    replay_ring_bytes,
+    replay_ring_record,
+    summarize_device_memory,
+    train_state_record,
+    tree_bytes,
+)
 from .perf import UtilizationMeter, summarize_utilization
 from .tracer import SpanTracer, summarize_trace_file
 
@@ -59,12 +77,22 @@ __all__ = [
     "TelemetryConfig",
     "UtilizationMeter",
     "Watchdog",
+    "attribution_rows",
+    "compose_budget",
     "dump_thread_stacks",
+    "estimate_fit",
+    "fit_verdict",
     "health_verdict",
+    "program_memory_record",
     "read_health",
     "read_ledger",
+    "replay_ring_bytes",
+    "replay_ring_record",
+    "summarize_device_memory",
     "summarize_trace_file",
     "summarize_utilization",
+    "train_state_record",
+    "tree_bytes",
 ]
 
 TRACE_FILENAME = "trace.json"
@@ -112,6 +140,8 @@ class RunTelemetry:
             warmup=self.config.ANOMALY_WARMUP_STEPS,
             window=self.config.ANOMALY_WINDOW,
             entropy_floor=self.config.ENTROPY_COLLAPSE_THRESHOLD,
+            memory_growth_ticks=self.config.MEMORY_GROWTH_TICKS,
+            memory_growth_fraction=self.config.MEMORY_GROWTH_MIN_FRACTION,
         )
         # Durable metrics ledger + live utilization accounting (the
         # persistence-and-analysis tier under the span/heartbeat
@@ -139,6 +169,7 @@ class RunTelemetry:
                 clock=clock,
             )
         self._step = 0
+        self._memory_seen: set = set()
         self._last_write_mono = None
         self._last_written_step: int | None = None
         self._clock = clock
@@ -165,6 +196,9 @@ class RunTelemetry:
             return
         if step is not None:
             self._step = step
+        # Programs that compiled after the last util tick still land in
+        # the ledger's attribution record.
+        self._ledger_compile_memory()
         self.health.write()
         n = self.tracer.export(self.run_dir / TRACE_FILENAME)
         logger.info(
@@ -215,6 +249,33 @@ class RunTelemetry:
         if self.ledger is not None and means:
             self.ledger.append(tick_record(step, means))
 
+    def record_memory(self, record: "dict | None") -> None:
+        """Ledger one static memory-attribution record (train-state
+        tree bytes, replay-ring bytes, program memory_analysis —
+        telemetry/memory.py; `cli mem` renders these)."""
+        if self.ledger is not None and record:
+            self.ledger.append(record)
+
+    def _ledger_compile_memory(self) -> None:
+        """Append program memory records the compile cache has captured
+        but this run's ledger hasn't seen yet (programs compile lazily
+        on first dispatch, so this runs every util tick and at close;
+        the seen-set is per run — several runs in one process each get
+        the full attribution)."""
+        if self.ledger is None:
+            return
+        try:
+            from ..compile_cache import get_compile_cache
+
+            for record in get_compile_cache().memory_summary():
+                rid = (record.get("program"), record.get("key"))
+                if rid in self._memory_seen:
+                    continue
+                self._memory_seen.add(rid)
+                self.ledger.append(record)
+        except Exception:  # accounting must never hurt the loop
+            pass
+
     def on_util_tick(self, step: int, **counters) -> "dict | None":
         """Derive + persist one utilization record from the loop's
         cumulative counters (see UtilizationMeter.tick for the keys).
@@ -233,12 +294,28 @@ class RunTelemetry:
                 counters["compile_misses"] = cc.get("misses", 0)
             except Exception:  # never let accounting hurt the loop
                 pass
+        if "device_memory" not in counters:
+            try:
+                # The writer side runs beside JAX by definition; the
+                # lazy import keeps reader processes JAX-free.
+                from .health import device_memory_stats
+
+                counters["device_memory"] = device_memory_stats()
+            except Exception:
+                pass
+        self._ledger_compile_memory()
         record = self.perf.tick(step, **counters)
         if record is None:
             return None
         if self.ledger is not None:
             self.ledger.append(record)
         self.health.note_utilization(record)
+        in_use = record.get("mem_bytes_in_use")
+        if self.config.ANOMALY_ENABLED and isinstance(in_use, (int, float)):
+            for a in self.anomaly.observe_memory(in_use, step):
+                logger.warning("Training anomaly: %s", a.describe())
+                if self.stats is not None:
+                    self.stats.log_scalar(f"Anomaly/{a.kind}", 1.0, step)
         if self.config.PROMETHEUS_TEXTFILE:
             write_prometheus_textfile(
                 self.run_dir / PROM_FILENAME, record, self.run_name

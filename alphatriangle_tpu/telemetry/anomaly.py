@@ -18,6 +18,12 @@ Checks per observation:
   (policy entropy hitting ~0 means the policy head has saturated and
   self-play exploration is gone). Latched: fires once per excursion,
   re-arms when the metric recovers.
+- **memory_growth** (`observe_memory`, fed per utilization tick with
+  device `bytes_in_use`): fires when memory grows MONOTONICALLY for a
+  configured run of ticks AND the total growth over that run exceeds a
+  relative floor — the leak signature, as opposed to the sawtooth of
+  a healthy allocator. Latched per excursion; any decrease re-arms and
+  restarts the run.
 """
 
 import math
@@ -33,7 +39,7 @@ EPS_REL = 1e-3
 class Anomaly:
     """One detected anomaly, with recent-window context for the log."""
 
-    kind: str  # "nonfinite" | "spike" | "collapse"
+    kind: str  # "nonfinite" | "spike" | "collapse" | "memory_growth"
     metric: str
     step: int
     value: float
@@ -50,6 +56,11 @@ class Anomaly:
             )
         elif self.kind == "collapse":
             parts.append(f"value {self.value:.6g} at/below collapse floor")
+        elif self.kind == "memory_growth":
+            parts.append(
+                f"bytes_in_use {self.value:,.0f} grew monotonically from "
+                f"{self.mean:,.0f} (possible leak)"
+            )
         else:
             parts.append(f"value {self.value!r}")
         if self.window:
@@ -80,6 +91,8 @@ class AnomalyDetector:
         window: int = 32,
         entropy_floor: float = 0.01,
         entropy_metrics: tuple[str, ...] = ("Loss/Entropy",),
+        memory_growth_ticks: int = 12,
+        memory_growth_fraction: float = 0.05,
     ) -> None:
         self.alpha = alpha
         self.z_threshold = z_threshold
@@ -87,8 +100,17 @@ class AnomalyDetector:
         self.window = window
         self.entropy_floor = entropy_floor
         self.entropy_metrics = set(entropy_metrics)
+        self.memory_growth_ticks = memory_growth_ticks
+        self.memory_growth_fraction = memory_growth_fraction
         self._lock = threading.Lock()
         self._state: dict[str, _MetricState] = {}
+        # Leak-detector state (observe_memory): baseline at the start
+        # of the current monotonic run, its length, and the latch.
+        self._mem_prev: float | None = None
+        self._mem_base: float | None = None
+        self._mem_run = 0
+        self._mem_fired = False
+        self._mem_recent: deque = deque(maxlen=window)
 
     def observe(self, metric: str, value: float, step: int) -> list[Anomaly]:
         """Fold one observation; returns anomalies fired by it."""
@@ -140,6 +162,47 @@ class AnomalyDetector:
             st.var = (1.0 - a) * (st.var + a * d * d)
             st.n += 1
             st.recent.append((step, value))
+            return out
+
+    def observe_memory(self, bytes_in_use: float, step: int) -> list[Anomaly]:
+        """Fold one tick's device `bytes_in_use`; fires `memory_growth`
+        on a sustained monotonic climb (see module doc). One anomaly
+        per excursion: the latch re-arms only when memory decreases."""
+        value = float(bytes_in_use)
+        with self._lock:
+            out: list[Anomaly] = []
+            if not math.isfinite(value):
+                return out
+            if self._mem_prev is None or value < self._mem_prev:
+                # First sample, or memory released: a leak never shrinks
+                # — restart the monotonic run from here and re-arm.
+                self._mem_base = value
+                self._mem_run = 0
+                self._mem_fired = False
+            elif value > self._mem_prev:
+                self._mem_run += 1
+            self._mem_prev = value
+            base = self._mem_base or 0.0
+            grown = base > 0 and value >= base * (
+                1.0 + self.memory_growth_fraction
+            )
+            if (
+                self._mem_run >= self.memory_growth_ticks
+                and grown
+                and not self._mem_fired
+            ):
+                self._mem_fired = True
+                out.append(
+                    Anomaly(
+                        "memory_growth",
+                        "Memory/bytes_in_use",
+                        step,
+                        value,
+                        mean=base,
+                        window=list(self._mem_recent),
+                    )
+                )
+            self._mem_recent.append((step, value))
             return out
 
     def observe_metrics(

@@ -35,15 +35,32 @@ from pathlib import Path
 logger = logging.getLogger(__name__)
 
 
+def _host_ram_bytes() -> "int | None":
+    """Total host RAM (the XLA:CPU 'device' allocates from it)."""
+    try:
+        return os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
 def device_memory_stats() -> list[dict]:
-    """Per-device memory snapshot; [] wherever the backend (e.g. CPU)
-    doesn't report. Imports jax lazily so heartbeat READERS never pay
-    for (or hang on) accelerator init."""
+    """Per-device memory snapshot. Imports jax lazily so heartbeat
+    READERS never pay for (or hang on) accelerator init.
+
+    Accelerator backends report through the allocator
+    (`device.memory_stats()`: bytes_in_use / peak_bytes_in_use /
+    bytes_limit). XLA:CPU reports nothing there, so the CPU fallback
+    synthesizes `bytes_in_use` from `jax.live_arrays()` (exact array
+    bytes, no allocator slop; `source: "live_arrays"`) with host RAM as
+    the limit — which is what makes the whole memory-observability
+    pipeline exercisable in tier-1. Peak is left to the meter's
+    high-water tracker (telemetry/perf.py)."""
     try:
         import jax
 
         out = []
-        for d in jax.local_devices():
+        devices = jax.local_devices()
+        for d in devices:
             stats = getattr(d, "memory_stats", lambda: None)()
             if not stats:
                 continue
@@ -56,7 +73,33 @@ def device_memory_stats() -> list[dict]:
                     "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
                 }
             )
-        return out
+        if out or not devices:
+            return out
+        # No device reported an allocator: synthesize from live arrays.
+        in_use = {d.id: 0 for d in devices}
+        for a in jax.live_arrays():
+            try:
+                devs = [d for d in a.devices() if d.id in in_use]
+                nbytes = int(a.nbytes)
+            except Exception:
+                continue
+            if not devs:
+                continue
+            share = nbytes // len(devs)
+            for d in devs:
+                in_use[d.id] += share
+        ram = _host_ram_bytes()
+        return [
+            {
+                "device": d.id,
+                "kind": getattr(d, "device_kind", d.platform),
+                "bytes_in_use": in_use[d.id],
+                "bytes_limit": ram if d.platform == "cpu" else None,
+                "peak_bytes_in_use": None,
+                "source": "live_arrays",
+            }
+            for d in devices
+        ]
     except Exception:
         return []
 
@@ -136,6 +179,10 @@ class HealthMonitor:
             "transfer_h2d_ms",
             "transfer_d2h_ms",
             "compile_cache_hit_rate",
+            "mem_bytes_in_use",
+            "mem_peak_bytes_in_use",
+            "mem_bytes_limit",
+            "mem_utilization",
         )
         trimmed = {k: record.get(k) for k in keep if k in record}
         with self._lock:

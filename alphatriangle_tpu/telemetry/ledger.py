@@ -4,10 +4,14 @@ The StatsCollector's series live in memory and die with the process;
 TensorBoard event files need TensorBoard to read back; the five
 `BENCH_r0*.json` snapshots are the entire cross-run record. This module
 is the persistence tier under all of them: every processed metric batch
-and every derived utilization record (telemetry/perf.py) is appended as
-one JSON line to `runs/<run>/metrics.jsonl` — crash-safely, rotation-
-bounded, and readable by processes that never import JAX (`cli perf`,
-`cli compare`, `cli watch`, a rsync'd laptop shell).
+(`kind: "tick"`), every derived utilization record (`kind: "util"`,
+telemetry/perf.py — including per-device memory in-use/peak fields) and
+every memory-attribution record (`kind: "memory"`, telemetry/memory.py
+— train-state tree bytes, replay-ring bytes, per-program AOT
+memory_analysis) is appended as one JSON line to
+`runs/<run>/metrics.jsonl` — crash-safely, rotation-bounded, and
+readable by processes that never import JAX (`cli perf`, `cli compare`,
+`cli mem`, `cli watch`, a rsync'd laptop shell).
 
 Crash-safety model (KataGo/Podracer-style continuous accounting needs
 the record to survive the run dying at ANY instant):
@@ -206,6 +210,10 @@ _PROM_HELP = {
     "transfer_h2d_ms": "Host->device staging time this tick, ms",
     "transfer_d2h_ms": "Device->host fetch time this tick, ms",
     "compile_cache_hit_rate": "AOT executable cache hit rate so far",
+    "mem_bytes_in_use": "Device memory in use across local devices, bytes",
+    "mem_peak_bytes_in_use": "Run-wide peak device memory in use, bytes",
+    "mem_bytes_limit": "Device memory limit across local devices, bytes",
+    "mem_utilization": "Device memory in use / limit",
     "step": "Learner global step",
 }
 

@@ -1,0 +1,443 @@
+"""Memory ledger: per-program HBM attribution + OOM pre-flight math.
+
+The telemetry stack answers "how fast" (perf.py) and "is it alive"
+(health.py) but, before this module, not "where did the HBM go" — the
+question that decides whether a bigger batch, a deeper net, or a larger
+device-resident replay ring fits BEFORE a scarce TPU window is burned
+on an OOM. Podracer-style pipelines (arXiv:2104.06272) and MindSpeed RL
+(arXiv:2507.19017) both treat per-component memory accounting and
+ahead-of-time fit checks as first-class infrastructure; this is that
+tier here:
+
+- **Static attribution.** Every program wrapped by
+  `compile_cache.CachedProgram` records its AOT
+  `compiled.memory_analysis()` — argument / output / temp /
+  generated-code bytes — at compile time (`program_memory_record`),
+  persisted beside the executable artifact and drained into the run's
+  `metrics.jsonl` as `kind: "memory"` records. Model/optimizer/
+  train-state bytes come from tree-size accounting (`tree_bytes`,
+  `train_state_record`), replay-ring bytes from the device buffers'
+  own dtype/shape math (`replay_ring_bytes` — asserted equal to the
+  allocated storage in tests).
+- **Budget composition.** `compose_budget` folds those records into a
+  worst-case per-device budget: persistent train state + device ring +
+  rollout-carry residency (chunk-program arguments minus params) +
+  the worst single program's transient (temp + output). `cli fit`
+  checks it against `bytes_limit`; `cli mem` renders the attribution
+  table; `cli compare` gates `memory_budget_bytes` across runs.
+- **Live accounting** lives in `perf.UtilizationMeter` (per-tick
+  `mem_bytes_in_use`/`mem_peak_bytes_in_use` + high-water tracking)
+  and `health.device_memory_stats`; the leak detector is
+  `anomaly.AnomalyDetector.observe_memory` (`Anomaly/memory_growth`).
+
+Reader functions here never import JAX — `cli mem` must render a run's
+attribution from artifacts alone beside a wedged chip. Anything that
+needs JAX (tree accounting, the fit estimator) imports it lazily.
+"""
+
+import logging
+import math
+import time
+
+logger = logging.getLogger(__name__)
+
+MEMORY_KIND = "memory"
+
+# Operator-supplied per-device byte budget override: lets `cli fit`
+# assert a denominator for backends that report no allocator limit
+# (parallel to utils/flops.py's ALPHATRIANGLE_PEAK_TFLOPS).
+BYTES_LIMIT_ENV = "ALPHATRIANGLE_DEVICE_BYTES_LIMIT"
+
+# `cli fit` exit codes.
+FIT_OK = 0  # budget fits the per-device limit
+FIT_OVER = 1  # budget exceeds the limit
+FIT_UNKNOWN = 2  # no device byte limit known (and no override)
+
+
+def fmt_bytes(n) -> str:
+    """Human bytes for tables: '1.50 GiB' / '320.0 KiB' / '—'."""
+    if not isinstance(n, (int, float)) or isinstance(n, bool):
+        return "—"
+    n = float(n)
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:,.2f} {unit}"
+    return f"{n:,.0f} B"
+
+
+# --- static attribution records -----------------------------------------
+
+
+def program_memory_record(
+    name: str,
+    compiled,
+    backend: str = "",
+    key: str = "",
+    origin: str = "compile",
+) -> "dict | None":
+    """One `kind: "memory"` record from an AOT program's
+    `memory_analysis()` (argument/output/temp/generated-code bytes).
+    None when the executable doesn't support the analysis (exotic
+    backends) — attribution degrades, nothing raises."""
+    analysis = getattr(compiled, "memory_analysis", None)
+    if analysis is None:
+        return None
+    try:
+        stats = analysis()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+
+    def grab(attr: str) -> "int | None":
+        v = getattr(stats, attr, None)
+        return int(v) if isinstance(v, (int, float)) else None
+
+    b = {
+        "argument": grab("argument_size_in_bytes"),
+        "output": grab("output_size_in_bytes"),
+        "temp": grab("temp_size_in_bytes"),
+        "generated_code": grab("generated_code_size_in_bytes"),
+        "alias": grab("alias_size_in_bytes"),
+    }
+    if all(v is None for v in b.values()):
+        return None
+    # TPU analyses additionally expose a whole-program peak; keep it
+    # when present (it subsumes temp+output as the transient bound).
+    peak = grab("peak_memory_in_bytes")
+    v = {k: x or 0 for k, x in b.items()}
+    rec = {
+        "kind": MEMORY_KIND,
+        "category": "program",
+        "component": f"program/{name}",
+        "program": name,
+        "key": key,
+        "backend": backend,
+        "origin": origin,
+        "bytes": b,
+        "total": v["argument"] + v["output"] + v["temp"] + v["generated_code"],
+        # Extra bytes one dispatch needs beyond its resident arguments:
+        # temps plus the NON-aliased outputs (donated outputs reuse
+        # argument buffers — `alias` bytes — and allocate nothing new).
+        "transient": v["temp"] + max(0, v["output"] - v["alias"]),
+        "time": time.time(),
+    }
+    if peak is not None:
+        rec["peak"] = peak
+    return rec
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (shape x dtype
+    itemsize — works on concrete arrays and ShapeDtypeStructs alike).
+    Lazy JAX import: this is a writer-side helper."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dtype = getattr(leaf, "dtype", None)
+        size = getattr(leaf, "size", None)
+        if dtype is None or size is None:
+            continue
+        try:
+            total += int(size) * int(np.dtype(dtype).itemsize)
+        except TypeError:
+            continue
+    return total
+
+
+def train_state_record(state) -> dict:
+    """Tree-size accounting of one TrainState: params vs optimizer
+    state vs batch stats (the bytes `training/setup.py` ledgers)."""
+    parts = {
+        "params": tree_bytes(getattr(state, "params", None)),
+        "opt_state": tree_bytes(getattr(state, "opt_state", None)),
+        "batch_stats": tree_bytes(getattr(state, "batch_stats", None)),
+    }
+    total = tree_bytes(state)
+    return {
+        "kind": MEMORY_KIND,
+        "category": "state",
+        "component": "train_state",
+        "bytes": parts,
+        "total": total,
+        "time": time.time(),
+    }
+
+
+def replay_ring_bytes(
+    capacity: int,
+    grid_shape: tuple,
+    other_dim: int,
+    action_dim: int,
+    shards: int = 1,
+) -> int:
+    """Exact bytes of a device replay ring's storage, from the same
+    dtype/shape math the buffers allocate with: one int8 grid cell per
+    board cell, float32 everything else, one trash row per shard
+    (rl/device_buffer.py / rl/sharded_device_buffer.py — tests assert
+    this equals the allocated storage bit for bit)."""
+    rows = int(capacity) + int(shards)
+    row_bytes = (
+        int(math.prod(grid_shape))  # grid, int8
+        + 4 * int(other_dim)  # other_features, float32
+        + 4 * int(action_dim)  # policy_target, float32
+        + 4  # value_target, float32
+        + 4  # policy_weight, float32
+    )
+    return rows * row_bytes
+
+
+def replay_ring_record(
+    total_bytes: int,
+    capacity: int,
+    shards: int = 1,
+    location: str = "device",
+) -> dict:
+    """The ledger record for one replay ring (location "device" for the
+    HBM-resident rings, "host" for the NumPy buffer — host rings are
+    listed in the attribution table but excluded from the HBM budget)."""
+    return {
+        "kind": MEMORY_KIND,
+        "category": "ring",
+        "component": "replay_ring",
+        "bytes": {"storage": int(total_bytes)},
+        "total": int(total_bytes),
+        "capacity": int(capacity),
+        "shards": int(shards),
+        "location": location,
+        "time": time.time(),
+    }
+
+
+# --- live totals ---------------------------------------------------------
+
+
+def summarize_device_memory(device_memory) -> "dict | None":
+    """Fold `health.device_memory_stats()` rows into run totals:
+    summed in-use/peak, summed limit (None when no device reports one).
+    """
+    if not device_memory:
+        return None
+    in_use = 0
+    peak = 0
+    limits = []
+    for d in device_memory:
+        if not isinstance(d, dict):
+            continue
+        u = d.get("bytes_in_use")
+        if isinstance(u, (int, float)):
+            in_use += int(u)
+        p = d.get("peak_bytes_in_use")
+        peak += int(p) if isinstance(p, (int, float)) else (
+            int(u) if isinstance(u, (int, float)) else 0
+        )
+        lim = d.get("bytes_limit")
+        if isinstance(lim, (int, float)) and lim > 0:
+            limits.append(int(lim))
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "bytes_limit": sum(limits) if limits else None,
+    }
+
+
+# --- budget composition --------------------------------------------------
+
+
+def latest_by_component(records) -> dict:
+    """Newest record per component name (re-compiles and re-runs
+    re-emit records; attribution wants the latest of each)."""
+    out: dict = {}
+    for rec in records:
+        if isinstance(rec, dict) and rec.get("component"):
+            out[rec["component"]] = rec
+    return out
+
+
+def compose_budget(records) -> dict:
+    """Fold memory records into the static per-device budget.
+
+    total = train-state bytes (params + optimizer + batch stats,
+    resident for the whole run) + device replay ring + rollout carry
+    residency (the chunk program's argument bytes minus the params it
+    shares with the train state — game/tree state that stays resident
+    between chunks) + the worst single program's transient (temp +
+    output; the program-reported `peak` wins when present). Host rings
+    are excluded: they live in host RAM, not HBM.
+    """
+    latest = latest_by_component(records)
+    state = next(
+        (r for r in latest.values() if r.get("category") == "state"), None
+    )
+    rings = [r for r in latest.values() if r.get("category") == "ring"]
+    programs = [
+        r for r in latest.values() if r.get("category") == "program"
+    ]
+    params_bytes = int(((state or {}).get("bytes") or {}).get("params") or 0)
+    state_total = int((state or {}).get("total") or 0)
+    ring_device = sum(
+        int(r.get("total") or 0)
+        for r in rings
+        if r.get("location") == "device"
+    )
+    rollout_resident = 0
+    transient = 0
+    for rec in programs:
+        b = rec.get("bytes") or {}
+        arg = int(b.get("argument") or 0)
+        if str(rec.get("program") or "").startswith("self_play"):
+            rollout_resident = max(rollout_resident, max(0, arg - params_bytes))
+        peak = rec.get("peak")
+        t = (
+            int(peak)
+            if isinstance(peak, (int, float))
+            else int(rec.get("transient") or 0)
+        )
+        transient = max(transient, t)
+    return {
+        "train_state_bytes": state_total,
+        "replay_ring_bytes": ring_device,
+        "rollout_resident_bytes": rollout_resident,
+        "program_transient_bytes": transient,
+        "total_bytes": state_total + ring_device + rollout_resident + transient,
+        "programs": len(programs),
+    }
+
+
+def fit_verdict(total_bytes, bytes_limit) -> tuple:
+    """(exit code, reason) for a budget against a per-device limit."""
+    if not isinstance(bytes_limit, (int, float)) or bytes_limit <= 0:
+        return FIT_UNKNOWN, (
+            "no device byte limit known for this backend (set "
+            f"{BYTES_LIMIT_ENV} to assert one)"
+        )
+    frac = total_bytes / bytes_limit
+    if total_bytes <= bytes_limit:
+        return FIT_OK, (
+            f"fits: {fmt_bytes(total_bytes)} is {frac:.1%} of the "
+            f"{fmt_bytes(bytes_limit)} per-device limit"
+        )
+    return FIT_OVER, (
+        f"OVER BUDGET: {fmt_bytes(total_bytes)} is {frac:.1%} of the "
+        f"{fmt_bytes(bytes_limit)} per-device limit"
+    )
+
+
+# --- attribution rendering (no JAX on this path) -------------------------
+
+
+def attribution_rows(records) -> list:
+    """(component, total bytes, detail) rows for `cli mem`'s table,
+    biggest first."""
+    rows = []
+    for rec in latest_by_component(records).values():
+        b = rec.get("bytes") or {}
+        cat = rec.get("category")
+        if cat == "program":
+            detail = (
+                f"args {fmt_bytes(b.get('argument'))}, "
+                f"out {fmt_bytes(b.get('output'))}, "
+                f"temp {fmt_bytes(b.get('temp'))}, "
+                f"code {fmt_bytes(b.get('generated_code'))}"
+            )
+        elif cat == "state":
+            detail = (
+                f"params {fmt_bytes(b.get('params'))}, "
+                f"opt {fmt_bytes(b.get('opt_state'))}, "
+                f"bn {fmt_bytes(b.get('batch_stats'))}"
+            )
+        elif cat == "ring":
+            detail = (
+                f"capacity {rec.get('capacity'):,} x {rec.get('shards')} "
+                f"shard(s), {rec.get('location')}"
+            )
+        else:
+            detail = ""
+        rows.append((rec.get("component") or "?", rec.get("total") or 0, detail))
+    rows.sort(key=lambda r: -r[1])
+    return rows
+
+
+# --- pre-flight estimator (JAX-side; `cli fit`) --------------------------
+
+
+def estimate_fit(
+    env_config,
+    model_config,
+    mcts_config,
+    train_config,
+    fused_k: int = 4,
+    device_replay: bool = False,
+    progress=None,
+) -> dict:
+    """Build the run's hot programs AOT (lowered + compiled, never
+    executed) and compose the static memory budget for them.
+
+    Returns {"records": [...], "budget": compose_budget(...)}. The
+    device-replay gather program is not lowered here — lowering it
+    needs the ring allocated, which is exactly the allocation a
+    pre-flight must not make; the ring is accounted statically and the
+    gather's transient is bounded by the fused program's.
+    """
+    from ..env.engine import TriangleEnv
+    from ..features.core import get_feature_extractor
+    from ..nn.network import NeuralNetwork
+    from ..rl.self_play import SelfPlayEngine
+    from ..rl.trainer import Trainer
+
+    def say(msg: str) -> None:
+        logger.info(msg)
+        if progress is not None:
+            progress(msg)
+
+    env = TriangleEnv(env_config)
+    extractor = get_feature_extractor(env, model_config)
+    net = NeuralNetwork(model_config, env_config, seed=0)
+    engine = SelfPlayEngine(
+        env, extractor, net, mcts_config, train_config, seed=0
+    )
+    trainer = Trainer(net, train_config)
+
+    records = [train_state_record(trainer.state)]
+    ring_bytes = replay_ring_bytes(
+        train_config.BUFFER_CAPACITY,
+        (model_config.GRID_INPUT_CHANNELS, env_config.ROWS, env_config.COLS),
+        extractor.other_dim,
+        env_config.action_dim,
+    )
+    records.append(
+        replay_ring_record(
+            ring_bytes,
+            train_config.BUFFER_CAPACITY,
+            location="device" if device_replay else "host",
+        )
+    )
+    chunk = train_config.ROLLOUT_CHUNK_MOVES
+    lbatch = train_config.BATCH_SIZE
+    targets = (
+        (f"self_play_chunk/t{chunk}", lambda: engine.analyze_chunk(chunk)),
+        (f"learner_step/b{lbatch}", lambda: trainer.analyze_step(lbatch)),
+        (
+            f"learner_fused/k{fused_k}",
+            lambda: trainer.analyze_steps(fused_k, lbatch),
+        ),
+    )
+    for label, fn in targets:
+        t0 = time.time()
+        try:
+            rec = fn()
+        except Exception as exc:  # one unanalyzable program != no report
+            logger.warning("fit: %s analysis failed (%s)", label, exc)
+            rec = None
+        if rec is not None:
+            records.append(rec)
+            say(
+                f"fit: {label}: args {fmt_bytes(rec['bytes'].get('argument'))}"
+                f" temp {fmt_bytes(rec['bytes'].get('temp'))}"
+                f" ({time.time() - t0:.1f}s)"
+            )
+        else:
+            say(f"fit: {label}: no memory analysis available")
+    return {"records": records, "budget": compose_budget(records)}

@@ -36,13 +36,23 @@ logger = logging.getLogger(__name__)
 
 SUMMARY_SCHEMA = "alphatriangle.perf.v1"
 
-# Metrics `cli compare` aligns between two runs, with direction
-# (True = higher is better; every current metric is a throughput).
+# Metrics `cli compare` aligns between two runs. Throughputs regress
+# when they DROP; the memory metrics (peak bytes per device run-wide,
+# composed static budget) regress when they GROW — a run that suddenly
+# needs more HBM is a regression against the fit headroom even when it
+# is no slower.
 COMPARE_METRICS = (
     "games_per_hour",
     "moves_per_sec",
     "learner_steps_per_sec",
     "mfu",
+    "mem_peak_bytes_in_use",
+    "memory_budget_bytes",
+)
+
+# Metrics where a LOWER candidate value is the good direction.
+LOWER_IS_BETTER = frozenset(
+    {"mem_peak_bytes_in_use", "memory_budget_bytes"}
 )
 
 
@@ -72,6 +82,10 @@ class UtilizationMeter:
         self.peak_source = source
         self._clock = clock
         self._prev: "dict | None" = None
+        # Run-wide high-water of observed bytes_in_use: the backstop
+        # peak where the backend reports no peak_bytes_in_use (XLA:CPU
+        # synthesized stats — telemetry/health.py).
+        self._mem_high_water = 0
 
     def device_info(self) -> dict:
         """Static device facts for `health.json` / summaries."""
@@ -92,9 +106,14 @@ class UtilizationMeter:
         transfer_d2h_s: float = 0.0,
         compile_hits: int = 0,
         compile_misses: int = 0,
+        device_memory: "list | None" = None,
     ) -> "dict | None":
         """One derived utilization record, or None (first/zero-width tick)."""
         now = self._clock()
+        # Memory accounting folds on EVERY tick (including the baseline
+        # tick that yields no rate record) so the high-water mark never
+        # misses a sample.
+        mem = self._fold_memory(device_memory)
         cur = {
             "step": step,
             "episodes": episodes,
@@ -126,6 +145,7 @@ class UtilizationMeter:
         )
         total_compiles = compile_hits + compile_misses
         return {
+            **(mem or {}),
             "kind": "util",
             "step": step,
             "time": time.time(),
@@ -166,6 +186,43 @@ class UtilizationMeter:
                 else None
             ),
         }
+
+    def _fold_memory(self, device_memory: "list | None") -> "dict | None":
+        """Device-memory totals for one tick (telemetry/memory.py) +
+        the run-wide high-water update. None when the backend reports
+        nothing (the record then simply carries no mem_* fields)."""
+        from .memory import summarize_device_memory
+
+        totals = summarize_device_memory(device_memory)
+        if totals is None:
+            return None
+        in_use = totals["bytes_in_use"]
+        self._mem_high_water = max(self._mem_high_water, in_use)
+        peak = max(self._mem_high_water, totals["peak_bytes_in_use"])
+        limit = totals["bytes_limit"]
+        out = {
+            "mem_bytes_in_use": in_use,
+            "mem_peak_bytes_in_use": peak,
+            "mem_bytes_limit": limit,
+            "mem_utilization": (
+                round(in_use / limit, 6) if limit else None
+            ),
+            "mem_devices": [
+                {
+                    k: d.get(k)
+                    for k in (
+                        "device",
+                        "kind",
+                        "bytes_in_use",
+                        "peak_bytes_in_use",
+                        "bytes_limit",
+                    )
+                }
+                for d in device_memory
+                if isinstance(d, dict)
+            ],
+        }
+        return out
 
 
 # --- summaries ----------------------------------------------------------
@@ -252,6 +309,20 @@ def summarize_utilization(
         "transfer_h2d_ms": _mean(col("transfer_h2d_ms")),
         "transfer_d2h_ms": _mean(col("transfer_d2h_ms")),
         "compile_cache_hit_rate": last.get("compile_cache_hit_rate"),
+        # Memory (telemetry/memory.py): run-wide observed peak, plus
+        # the newest in-use/limit snapshot for the `cli perf` readout.
+        "mem_peak_bytes_in_use": (
+            max(
+                (
+                    v
+                    for v in col("mem_peak_bytes_in_use")
+                    if isinstance(v, (int, float))
+                ),
+                default=None,
+            )
+        ),
+        "mem_bytes_in_use_last": last.get("mem_bytes_in_use"),
+        "mem_bytes_limit": last.get("mem_bytes_limit"),
         "throughput_trend": _trend(
             col("moves_per_sec")
             if any(isinstance(v, (int, float)) and v > 0 for v in col("moves_per_sec"))
@@ -319,6 +390,15 @@ def load_comparable(
     summary = summarize_utilization(read_ledger(ledger, kinds={"util"}))
     if summary is None:
         return None, f"{ledger}: no utilization records"
+    # Static memory budget from the run's attribution records, so
+    # `cli compare` can gate estimated-HBM growth next to observed peak.
+    from .memory import compose_budget
+
+    mem_records = read_ledger(ledger, kinds={"memory"})
+    if mem_records:
+        budget = compose_budget(mem_records)
+        if budget["total_bytes"] > 0:
+            summary["memory_budget_bytes"] = budget["total_bytes"]
     summary["source"] = str(ledger)
     return summary, str(ledger)
 
@@ -340,9 +420,11 @@ def compare_summaries(
 ) -> tuple[list, list]:
     """(rows, regressions) comparing candidate `a` against baseline `b`.
 
-    A row is (metric, a_value, b_value, ratio, status); status is
-    "regression" when a < b * (1 - threshold), "improved" when
-    a > b * (1 + threshold), else "ok"; "n/a" when either side is
+    A row is (metric, a_value, b_value, ratio, status). For throughput
+    metrics, status is "regression" when a < b * (1 - threshold) and
+    "improved" when a > b * (1 + threshold); for LOWER_IS_BETTER
+    metrics (peak bytes, memory budget) the directions flip — growth
+    past the threshold is the regression. "n/a" when either side is
     missing. `regressions` lists the regressed metric names.
     """
     rows = []
@@ -357,10 +439,14 @@ def compare_summaries(
             rows.append((metric, va, vb, None, "n/a"))
             continue
         ratio = va / vb
-        if ratio < 1.0 - threshold:
+        if metric in LOWER_IS_BETTER:
+            better, worse = ratio < 1.0 - threshold, ratio > 1.0 + threshold
+        else:
+            better, worse = ratio > 1.0 + threshold, ratio < 1.0 - threshold
+        if worse:
             status = "regression"
             regressions.append(metric)
-        elif ratio > 1.0 + threshold:
+        elif better:
             status = "improved"
         else:
             status = "ok"

@@ -346,6 +346,36 @@ def setup_training_components(
     from ..compile_cache import get_compile_cache
 
     get_compile_cache().set_tracer(telemetry.tracer)
+    # Static memory attribution -> metrics ledger (telemetry/memory.py):
+    # train-state bytes from tree-size accounting, replay-ring bytes
+    # from the buffers' own dtype/shape math. Program records join
+    # lazily as each program compiles (compile_cache memory capture);
+    # `cli mem <run>` renders the combined table from artifacts alone.
+    try:
+        from ..telemetry.memory import replay_ring_record, replay_ring_bytes, train_state_record
+
+        telemetry.record_memory(train_state_record(trainer.state))
+        if hasattr(buffer, "memory_record"):
+            telemetry.record_memory(buffer.memory_record())
+        else:
+            telemetry.record_memory(
+                replay_ring_record(
+                    replay_ring_bytes(
+                        train_config.BUFFER_CAPACITY,
+                        (
+                            model_config.GRID_INPUT_CHANNELS,
+                            env_config.ROWS,
+                            env_config.COLS,
+                        ),
+                        extractor.other_dim,
+                        env_config.action_dim,
+                    ),
+                    train_config.BUFFER_CAPACITY,
+                    location="host",
+                )
+            )
+    except Exception:
+        logger.exception("static memory attribution failed (continuing)")
     all_configs = {
         "env": env_config,
         "model": model_config,

@@ -1,12 +1,13 @@
-"""Utility layer: types, sum tree, helpers, geometry."""
+"""Utility layer: types, sum tree, helpers, geometry.
+
+The helpers module imports JAX at module level (platform enforcement,
+persistent-cache wiring), but this package also hosts `flops.py`, which
+JAX-free reader processes (`cli perf/mem/watch/health` beside a wedged
+chip) import through here — so the helpers re-exports resolve lazily
+(PEP 562) instead of dragging the JAX runtime into every reader.
+"""
 
 from alphatriangle_tpu.utils.geometry import is_point_in_polygon
-from alphatriangle_tpu.utils.helpers import (
-    format_eta,
-    get_device,
-    normalize_color_for_matplotlib,
-    set_random_seeds,
-)
 from alphatriangle_tpu.utils.sumtree import SumTree
 from alphatriangle_tpu.utils.types import (
     ActionType,
@@ -18,6 +19,26 @@ from alphatriangle_tpu.utils.types import (
     dense_policy_from_mapping,
     mapping_from_dense_policy,
 )
+
+_HELPER_EXPORTS = frozenset(
+    {
+        "format_eta",
+        "get_device",
+        "normalize_color_for_matplotlib",
+        "set_random_seeds",
+    }
+)
+
+
+def __getattr__(name: str):
+    if name in _HELPER_EXPORTS:
+        from alphatriangle_tpu.utils import helpers
+
+        return getattr(helpers, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 __all__ = [
     "ActionType",

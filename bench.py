@@ -381,8 +381,15 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     def snapshot(partial: "str | None") -> dict:
         global _last_partial
         # Refreshed at every snapshot: later sections (learner, fused,
-        # device-replay, overlapped) add their own compiles/hits.
+        # device-replay, overlapped) add their own compiles/hits — and
+        # their own program memory records + device memory high water.
         extra["compile_cache"] = compile_cache.stats()
+        from alphatriangle_tpu.telemetry.health import device_memory_stats
+
+        extra["memory"] = {
+            "device": device_memory_stats(),
+            "programs": compile_cache.memory_summary(),
+        }
         r = {
             "metric": "self_play_games_per_hour",
             "value": round(games_per_hour, 1),

@@ -6,9 +6,15 @@ accelerator, that the metrics-ledger pipeline end to end still works:
 1. a tiny CPU training run (test-sized world, ~8 learner steps) writes
    `metrics.jsonl` with utilization records (non-null MFU via the
    ALPHATRIANGLE_PEAK_TFLOPS override this script sets);
-2. `cli perf <run>` summarizes it — exit 2 there means the ledger
+2. the run's ledger carries memory observability records
+   (docs/OBSERVABILITY.md "Memory"): `kind: "memory"` attribution
+   lines (train state / replay ring / AOT program analysis) and
+   `mem_bytes_in_use` on the utilization records;
+3. `cli perf <run>` summarizes it — exit 2 there means the ledger
    schema broke;
-3. `cli compare <run> benchmarks/perf_reference_cpu_smoke.json`
+4. `cli fit cpu` composes the CPU-scale static memory budget against
+   the host byte limit and must exit 0 (the OOM pre-flight gate);
+5. `cli compare <run> benchmarks/perf_reference_cpu_smoke.json`
    gates against the checked-in reference summary. The threshold is
    deliberately generous (default 0.9: fail only on a >90% collapse)
    because CI hosts vary wildly in speed — the hard signal here is
@@ -146,10 +152,46 @@ def main() -> int:
         print(f"perf-smoke: training run failed (rc={rc})", file=sys.stderr)
         return rc
 
+    print("perf-smoke: memory records gate...", flush=True)
+    import json as _json
+
+    ledger = pc.get_run_base_dir() / "metrics.jsonl"
+    records = []
+    for line in ledger.read_text().splitlines():
+        try:
+            records.append(_json.loads(line))
+        except _json.JSONDecodeError:
+            continue
+    mem_records = [r for r in records if r.get("kind") == "memory"]
+    mem_utils = [
+        r
+        for r in records
+        if r.get("kind") == "util"
+        and isinstance(r.get("mem_bytes_in_use"), (int, float))
+    ]
+    if not mem_records or not mem_utils:
+        print(
+            f"perf-smoke: {ledger} holds {len(mem_records)} memory "
+            f"record(s) and {len(mem_utils)} util record(s) with "
+            "mem_bytes_in_use — memory observability broke",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"perf-smoke: {len(mem_records)} memory record(s), "
+        f"{len(mem_utils)} util record(s) with live accounting"
+    )
+
     print("perf-smoke: cli perf (schema gate)...", flush=True)
     rc = cli_main(["perf", RUN_NAME, "--root-dir", root])
     if rc != 0:
         print(f"perf-smoke: cli perf failed (rc={rc})", file=sys.stderr)
+        return rc
+
+    print("perf-smoke: cli fit cpu (OOM pre-flight gate)...", flush=True)
+    rc = cli_main(["fit", "cpu"])
+    if rc != 0:
+        print(f"perf-smoke: cli fit cpu failed (rc={rc})", file=sys.stderr)
         return rc
 
     if args.write_reference:
