@@ -75,6 +75,14 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         "HBM and batches are gathered there from sampled indices.",
     )
     p.add_argument(
+        "--fused-megastep",
+        action="store_true",
+        help="Anakin-style fused megastep: rollout chunk + ring ingest "
+        "+ on-device PER sampling + K learner steps as ONE device "
+        "program per iteration (single-device; needs the device ring — "
+        "rl/megastep.py, docs/PARALLELISM.md).",
+    )
+    p.add_argument(
         "--workers",
         type=int,
         default=None,
@@ -216,6 +224,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         overrides["ASYNC_ROLLOUTS"] = True
     if args.device_replay is not None:
         overrides["DEVICE_REPLAY"] = args.device_replay
+    if args.fused_megastep:
+        overrides["FUSED_MEGASTEP"] = True
     if args.workers is not None:
         overrides["NUM_SELF_PLAY_WORKERS"] = args.workers
     if args.replay_ratio is not None:
@@ -620,6 +630,7 @@ def cmd_perf(args: argparse.Namespace) -> int:
         f"   d2h {_fmt_cell(summary.get('transfer_d2h_ms'), ',.1f', 1, 'ms')}"
         f"   buffer fill {_fmt_cell(summary.get('buffer_fill_last'), ',.2f', 100.0, '%')}"
         f"   compile hits {_fmt_cell(summary.get('compile_cache_hit_rate'), ',.0f', 100.0, '%')}"
+        f"   dispatch/iter {_fmt_cell(summary.get('dispatches_per_iteration'), ',.1f')}"
     )
     mem_peak = summary.get("mem_peak_bytes_in_use")
     if mem_peak is not None or mem_budget is not None:
@@ -1188,6 +1199,10 @@ def cmd_fit(args: argparse.Namespace) -> int:
         plan.train,
         fused_k=plan.fused_k,
         device_replay=plan.device_replay,
+        # Bench-plan ring capacities are small (10k rows), so the
+        # megastep program — whose argument list includes the ring —
+        # is analyzed here too (rl/megastep.py).
+        megastep=True,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
     budget = report["budget"]

@@ -506,12 +506,16 @@ class CachedProgram:
         _, exe = self._executable_for(args)
         return exe is not _FALLBACK
 
-    def analyze(self, *args) -> "dict | None":
+    def analyze(self, *args, persist: bool = False) -> "dict | None":
         """Memory record for this program at these argument avals
         (telemetry/memory.py), compiling AOT if needed — WITHOUT
         executing anything. Works even for CPU-bypassed programs
         (cpu_aot=False guards *deserialization*; a fresh lower+compile
         purely for `memory_analysis()` is safe and is not serialized).
+        `persist=True` additionally writes the `.mem.json` sidecar on
+        the fresh-compile path (the megastep uses this so its record
+        survives into the cache dir even where the executable itself
+        is CPU-bypassed); the default keeps analysis artifact-free.
         None when the program can't lower or the backend reports no
         analysis. This is `cli fit`'s estimator entry point."""
         key = self._cache.signature(self.name, args, self._extra)
@@ -536,7 +540,7 @@ class CachedProgram:
             )
             return None
         return self._cache.capture_memory(
-            self.name, key, compiled, persist=False
+            self.name, key, compiled, persist=persist
         )
 
     def __call__(self, *args):

@@ -94,6 +94,21 @@ class TrainConfig(BaseModel):
     PRODUCER_MAX_RESTARTS: int = Field(default=3, ge=0)
     PRODUCER_RESTART_BACKOFF_S: float = Field(default=1.0, gt=0)
 
+    # --- Fused megastep (Anakin) orchestration ---
+    # Third loop mode (rl/megastep.py, docs/PARALLELISM.md "Megastep"):
+    # rollout chunk + device-ring ingest + on-device PER sampling + K
+    # fused learner steps run as ONE jitted device program, so the only
+    # per-iteration host work is fetching stats/metrics (one dispatch,
+    # one fetch). Weight sync is free and zero-staleness — the rollout
+    # reads the learner's live on-device params; there is no
+    # sync_to_network copy on the hot path. Requires the device-resident
+    # replay ring on a single-device, single-process mesh
+    # (DEVICE_REPLAY must not be "off"; megastep forces the ring on
+    # otherwise-ineligible backends the way DEVICE_REPLAY="on" does).
+    # Learner steps per megastep = LEARNER_STEPS_PER_ROLLOUT when set,
+    # else FUSED_LEARNER_STEPS. Mutually exclusive with ASYNC_ROLLOUTS.
+    FUSED_MEGASTEP: bool = Field(default=False)
+
     # --- Batching / buffer ---
     BATCH_SIZE: int = Field(default=256, ge=1)
     # Learner steps fused into ONE device dispatch (a lax.scan over
@@ -205,6 +220,22 @@ class TrainConfig(BaseModel):
         if v is not None and v <= 0:
             raise ValueError("GRADIENT_CLIP_VALUE must be positive if set.")
         return v
+
+    @model_validator(mode="after")
+    def _check_megastep(self) -> "TrainConfig":
+        if self.FUSED_MEGASTEP and self.ASYNC_ROLLOUTS:
+            raise ValueError(
+                "FUSED_MEGASTEP and ASYNC_ROLLOUTS are mutually "
+                "exclusive loop modes (the megastep already overlaps "
+                "acting and learning inside one device program)."
+            )
+        if self.FUSED_MEGASTEP and self.DEVICE_REPLAY == "off":
+            raise ValueError(
+                "FUSED_MEGASTEP needs the device-resident replay ring "
+                "(its sampling and ingest run on device); set "
+                "DEVICE_REPLAY to 'auto' or 'on'."
+            )
+        return self
 
     @model_validator(mode="after")
     def _check_beta(self) -> "TrainConfig":

@@ -1,6 +1,7 @@
 """RL core: replay buffer, trainer, self-play (reference `alphatriangle/rl/`)."""
 
 from .buffer import DenseSample, ExperienceBuffer
+from .megastep import MegastepRunner
 from .self_play import SelfPlayEngine
 from .trainer import Trainer, TrainState
 from .types import SelfPlayResult
@@ -8,6 +9,7 @@ from .types import SelfPlayResult
 __all__ = [
     "DenseSample",
     "ExperienceBuffer",
+    "MegastepRunner",
     "SelfPlayEngine",
     "SelfPlayResult",
     "TrainState",

@@ -82,20 +82,25 @@ def ring_scatter(
     cursor: jax.Array,
     blocks: tuple[dict[str, jax.Array], ...],
     cap: int,
+    with_positions: bool = False,
 ):
     """Flatten + validate + ring-scatter experience blocks (pure).
 
-    The single source of the ingest math for BOTH device rings: the
-    single-device buffer calls it whole-ring, the dp-sharded buffer
-    calls it per shard inside `shard_map` — the validation predicate
-    and keep/trash-slot rules must never diverge between them.
+    The single source of the ingest math for BOTH device rings AND the
+    fused megastep program (rl/megastep.py): the single-device buffer
+    calls it whole-ring, the dp-sharded buffer calls it per shard
+    inside `shard_map` — the validation predicate and keep/trash-slot
+    rules must never diverge between them.
 
     Each block holds arrays with arbitrary leading dims (the chunk
     program's (T,B) matured and (T,B,n) flushed outputs) plus a boolean
     `mask` over those leading dims. Rows are written in block order,
     leading-dims-major — the same order the host path produces via
     boolean indexing, so the paths fill identical slots with identical
-    rows. Returns (new_storage, new_cursor, rows_written)."""
+    rows. Returns (new_storage, new_cursor, rows_written); with
+    `with_positions` it additionally returns the per-row scatter slots
+    and keep mask, which the megastep needs to max-priority-init the
+    fresh rows in its device-resident PER array."""
 
     def flat(block: dict[str, jax.Array], f: str) -> jax.Array:
         lead = block["mask"].shape
@@ -142,7 +147,10 @@ def ring_scatter(
         .at[pos]
         .set(rows["pw"].astype(jnp.float32)),
     }
-    return new_storage, (cursor + count) % cap, count
+    new_cursor = (cursor + count) % cap
+    if with_positions:
+        return new_storage, new_cursor, count, pos, keep
+    return new_storage, new_cursor, count
 
 
 class DeviceReplayBuffer(ExperienceBuffer):
@@ -176,6 +184,9 @@ class DeviceReplayBuffer(ExperienceBuffer):
         self._grid_shape = grid_shape
         self._other_dim = other_dim
         self._ingest_jit = jax.jit(self._ingest_impl, donate_argnums=(0,))
+        # Device program dispatches this ring made (telemetry: the
+        # loop's dispatches-per-iteration gauge sums these counters).
+        self.dispatch_count = 0
 
     # --- device ingest ----------------------------------------------------
 
@@ -199,6 +210,7 @@ class DeviceReplayBuffer(ExperienceBuffer):
         self.storage, _, count_dev = self._ingest_jit(
             self.storage, jnp.int32(self._pos), blocks
         )
+        self.dispatch_count += 1
         count = int(count_dev)  # the one blocking scalar fetch
         slots = (self._pos + np.arange(count)) % self.capacity
         if self.tree is not None and count:

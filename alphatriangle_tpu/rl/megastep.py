@@ -1,0 +1,422 @@
+"""Anakin-style fused megastep: rollout chunk + ring ingest + K learner
+steps as ONE device program (Podracer, arXiv:2104.06272 §2 "Anakin").
+
+The round-5 bench showed the cost of host-orchestrated phases: the
+overlapped loop ran at 0.774x of serialized self-play and fused learner
+steps gained nothing (0.44 -> 0.45 steps/s), because every iteration
+pays per-phase host round trips — dispatch chunk, fetch, fold, sample,
+dispatch learner — and the phases contend in the device FIFO instead of
+composing. Anakin's answer is to keep acting, replay and learning
+inside one XLA program so the only host work per iteration is fetching
+metrics. This module composes the three seams the codebase already has
+into that program:
+
+- `SelfPlayEngine._chunk` (rl/self_play.py): `ROLLOUT_CHUNK_MOVES`
+  lockstep moves of all B games, driven by the learner's *current
+  on-device params* (`TrainState.params`), so weight sync is free and
+  ZERO-staleness — there is no `sync_to_network` copy on the hot path,
+  and every move of every megastep searches with the newest weights.
+- `ring_scatter` (rl/device_buffer.py): the chunk's masked experience
+  outputs scatter straight into the device-resident replay ring —
+  nothing is fetched, nothing is re-uploaded.
+- `Trainer._train_steps_from_impl` (rl/trainer.py): K training batches
+  are sampled ON DEVICE from the ring (stratified proportional PER over
+  a device-resident priority array, or uniform), gathered, and run as K
+  fused SGD steps.
+
+Only stats/metrics/TD summaries return to the host: ONE dispatch and
+ONE `device_get` per iteration, counter-asserted in the tests.
+
+PER semantics (host mirror reconciliation):
+
+The priority array lives on device and is the sampling truth inside the
+program: freshly ingested rows get max-priority init before sampling,
+and the group's TD errors update priorities in step order after the
+fused steps ((|δ|+ε)^α — the same formula as the host SumTree). The
+host SumTree stays alive as a *mirror*, reconciled at megastep
+boundaries from the returned (slots, TD errors): it serves beta
+annealing, readiness gating, the max-priority watermark, metrics and —
+critically — buffer persistence, so checkpoints and resume are
+interchangeable with the other loop modes. `sync_priorities_from_host`
+(re)seeds the device array from the mirror after restores/warmup.
+
+Scope: single-process, single-device mesh (the same gate as
+`DeviceReplayBuffer`). The dp-sharded megastep — per-device rings +
+`shard_map` sampling — is future work (docs/PARALLELISM.md).
+
+CPU note: the program contains learner steps, so it rides
+`cpu_aot=False` like the rest of the learner family (an XLA:CPU
+deserialized executable of a donating learner program returns the train
+state UNCHANGED — see rl/trainer.py). The donation/reload regression
+guard (params actually update across megasteps) is pinned in
+tests/test_megastep.py.
+"""
+
+import functools
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile_cache import config_digest, get_compile_cache
+from ..config.train_config import TrainConfig
+from .device_buffer import DeviceReplayBuffer, ring_scatter
+
+logger = logging.getLogger(__name__)
+
+
+class MegastepRunner:
+    """Owns the fused megastep program binding one (engine, trainer,
+    device ring) triple; the training loop's third mode
+    (`TrainConfig.FUSED_MEGASTEP`) drives it one call per iteration."""
+
+    def __init__(
+        self,
+        engine,
+        trainer,
+        buffer: DeviceReplayBuffer,
+        train_config: TrainConfig,
+    ):
+        if not getattr(buffer, "is_device", False) or getattr(
+            buffer, "is_sharded", False
+        ):
+            raise ValueError(
+                "MegastepRunner needs the single-device replay ring "
+                "(rl/device_buffer.DeviceReplayBuffer); the dp-sharded "
+                "megastep is not implemented yet."
+            )
+        if engine.mesh is not None:
+            raise ValueError(
+                "MegastepRunner is single-device: the self-play engine "
+                "must not be mesh-sharded (megastep over a dp mesh is "
+                "future work)."
+            )
+        if jax.process_count() > 1:
+            raise ValueError("MegastepRunner is single-process only.")
+        self.engine = engine
+        self.trainer = trainer
+        self.buffer = buffer
+        self.config = train_config
+        self.batch_size = train_config.BATCH_SIZE
+        self.cap = buffer.capacity
+        self.use_per = train_config.USE_PER
+        self.per_alpha = float(train_config.PER_ALPHA)
+        self.per_epsilon = float(train_config.PER_EPSILON)
+        self.beta_initial = float(train_config.PER_BETA_INITIAL)
+        self.beta_final = float(train_config.PER_BETA_FINAL)
+        self.beta_anneal = float(train_config.PER_BETA_ANNEAL_STEPS or 1)
+        # Device-resident priority array, (cap + 1,) float32 — the +1 is
+        # the trash slot, pinned at priority 0 so it is never sampled.
+        # None until `sync_priorities_from_host` seeds it (lazily on the
+        # first megastep, or explicitly after a checkpoint restore).
+        self._priorities: jax.Array | None = None
+        # One compiled program per distinct (chunk moves, K) pair, AOT
+        # cached. cpu_aot=False: the program donates + updates the train
+        # state, the exact family whose XLA:CPU deserialization silently
+        # returns donated state unchanged (rl/trainer.py).
+        extra = config_digest(
+            engine.mcts_config,
+            train_config,
+            trainer.nn.model_config,
+            engine.env.cfg,
+        ) + (
+            f"|att{int(getattr(trainer.nn.model, 'attention_fn', None) is not None)}"
+        )
+        self._megastep_fn = functools.lru_cache(maxsize=None)(
+            lambda t, k: get_compile_cache().wrap(
+                f"megastep/t{t}_k{k}",
+                jax.jit(
+                    functools.partial(self._impl, t, k),
+                    donate_argnums=(0, 1, 2, 3),
+                ),
+                extra=extra,
+                cpu_aot=False,
+            )
+        )
+        # Observability: program dispatches (the loop's one-dispatch-
+        # per-iteration assertion reads this) and blocking fetch time
+        # (telemetry/perf.py transfer accounting).
+        self.dispatch_count = 0
+        self.transfer_d2h_seconds = 0.0
+
+    # --- device program ---------------------------------------------------
+
+    def _sample_indices(self, priorities, size, state, k: int):
+        """On-device (K, B) slot sampling + IS weights.
+
+        PER: stratified proportional sampling over the priority array
+        via inclusive-cumsum + searchsorted — the vectorized equivalent
+        of the host SumTree's stratified descent (utils/sumtree.py).
+        Zero-priority (empty/trash) slots are never selected: their
+        cumsum segments are empty. Uniform: floor(u * size).
+        """
+        b = self.batch_size
+        rng, k_sample = jax.random.split(state.rng)
+        state = state.replace(rng=rng)
+        if self.use_per:
+            cum = jnp.cumsum(priorities[: self.cap])
+            total = cum[-1]
+            u = (
+                (jnp.arange(b, dtype=jnp.float32)[None, :]
+                 + jax.random.uniform(k_sample, (k, b)))
+                / b
+                * total
+            )
+            idx = jnp.clip(
+                jnp.searchsorted(cum, u), 0, self.cap - 1
+            ).astype(jnp.int32)
+            probs = jnp.maximum(priorities[idx], 1e-12) / jnp.maximum(
+                total, 1e-12
+            )
+            # Beta annealed on the learner-step clock, exactly as the
+            # host mirror's `ExperienceBuffer.beta` computes it.
+            frac = jnp.clip(
+                state.step.astype(jnp.float32) / self.beta_anneal, 0.0, 1.0
+            )
+            beta = self.beta_initial + frac * (
+                self.beta_final - self.beta_initial
+            )
+            w = (size.astype(jnp.float32) * probs) ** (-beta)
+            weights = (
+                w / jnp.max(w, axis=1, keepdims=True)
+            ).astype(jnp.float32)
+        else:
+            u = jax.random.uniform(k_sample, (k, b))
+            idx = jnp.clip(
+                jnp.floor(u * size.astype(jnp.float32)).astype(jnp.int32),
+                0,
+                jnp.maximum(size - 1, 0),
+            )
+            weights = jnp.ones((k, b), jnp.float32)
+        return state, idx, weights
+
+    def _impl(
+        self,
+        num_moves: int,
+        k: int,
+        state,
+        carry,
+        storage,
+        priorities,
+        cursor,
+        size,
+        max_priority,
+    ):
+        """The fused megastep (pure; donated: state, carry, storage,
+        priorities). Returns (state', carry', storage', priorities',
+        host outputs) — the host outputs are the ONLY fetch."""
+        # 1. Rollout chunk with the learner's live params: weight sync
+        # is the absence of a copy. The version tag for staleness
+        # accounting is the learner step itself (zero staleness by
+        # construction: every episode starts under the current step).
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        new_carry, outs = self.engine._chunk(
+            num_moves, variables, carry, state.step.astype(jnp.int32)
+        )
+        mat, flush = outs.pop("mat"), outs.pop("flush")
+
+        # 2. Scatter the harvest into the device ring (same math as
+        # DeviceReplayBuffer._ingest_impl, positions kept for PER).
+        new_storage, new_cursor, count, pos, keep = ring_scatter(
+            storage, cursor, (mat, flush), self.cap, with_positions=True
+        )
+        new_size = jnp.minimum(size + count, self.cap)
+
+        # 3. Max-priority init for the fresh rows (host-ring parity),
+        # trash slot pinned to 0 so sampling can never return it.
+        if self.use_per:
+            priorities = priorities.at[pos].set(
+                jnp.where(keep, max_priority, 0.0)
+            )
+            priorities = priorities.at[self.cap].set(0.0)
+
+        # 4. Sample K batches on device (post-ingest: fresh rows are
+        # immediately eligible, as in the sync loop's fold-then-sample).
+        state, idx, weights = self._sample_indices(
+            priorities, new_size, state, k
+        )
+
+        # 5. K fused learner steps gathered from the ring (the exact
+        # program body Trainer.train_steps_from dispatches).
+        new_state, metrics_k, td_k = self.trainer._train_steps_from_impl(
+            state, new_storage, idx, weights
+        )
+
+        # 6. Priority updates from the group's TD errors, in step order
+        # (deterministic last-write-wins for rows sampled by several
+        # steps — the same net effect as the host path's sequential
+        # per-step update_batch calls).
+        if self.use_per:
+            for j in range(k):
+                prio_j = (
+                    jnp.abs(td_k[j]) + self.per_epsilon
+                ) ** self.per_alpha
+                priorities = priorities.at[idx[j]].set(
+                    prio_j.astype(jnp.float32)
+                )
+
+        out = {
+            "rows_added": count,
+            "episode": outs["episode"],
+            "trace": outs["trace"],
+            "sentinel_live": outs["sentinel_live"],
+            "metrics": metrics_k,
+            "td": td_k,
+            "idx": idx,
+        }
+        return new_state, new_carry, new_storage, priorities, out
+
+    # --- host API ---------------------------------------------------------
+
+    def sync_priorities_from_host(self) -> None:
+        """(Re)seed the device priority array from the host SumTree
+        mirror — after warmup ingests, a checkpoint restore, or any
+        other host-side write. Device becomes the sampling truth from
+        the next megastep on."""
+        p = np.zeros(self.cap + 1, np.float32)
+        tree = self.buffer.tree
+        if tree is not None:
+            leaves = np.arange(self.cap) + tree._cap2
+            p[: self.cap] = tree.tree[leaves]
+        self._priorities = jnp.asarray(p)
+
+    def _dispatch_args(self, t: int, k: int) -> tuple:
+        if self._priorities is None:
+            self.sync_priorities_from_host()
+        buf = self.buffer
+        tree = buf.tree
+        max_p = float(tree.max_priority) if tree is not None else 1.0
+        args = (
+            self.trainer.state,
+            self.engine._carry,
+            buf.storage,
+            self._priorities,
+            jnp.int32(buf._pos),
+            jnp.int32(buf._size),
+            jnp.float32(max_p),
+        )
+        # Commit EVERY argument to the device before dispatch. The first
+        # call's arguments are a mix of uncommitted host-built arrays
+        # (initial carry window zeros, the seeded priority array, the
+        # per-call scalars) and committed jit outputs, while every later
+        # call sees all-committed outputs of the previous megastep — and
+        # jit keys compiled executables on that placement mapping, so
+        # without this the SECOND megastep silently recompiles the whole
+        # program (measured: a 48s duplicate compile at bench smoke
+        # scale). device_put is a no-op for anything already resident.
+        return jax.device_put(args, jax.devices()[0])
+
+    def run_megastep(
+        self, num_moves: int | None = None, k: int | None = None
+    ) -> tuple[list, int]:
+        """One fused megastep: ONE device dispatch, ONE blocking fetch.
+
+        Returns (per-step (metrics, TD errors) list — the
+        `train_steps_finish` contract — and the number of experience
+        rows ingested). Side effects: engine carry + episode stats,
+        buffer storage/counters + reconciled host PER mirror, trainer
+        state + host step mirror all advance.
+        """
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        k = int(k or max(1, self.config.FUSED_LEARNER_STEPS))
+        buf, engine, trainer = self.buffer, self.engine, self.trainer
+        tree = buf.tree
+        max_p = float(tree.max_priority) if tree is not None else 1.0
+        args = self._dispatch_args(t, k)
+        start_step = trainer._host_step
+        (
+            trainer.state,
+            engine._carry,
+            buf.storage,
+            self._priorities,
+            out,
+        ) = self._megastep_fn(t, k)(*args)
+        self.dispatch_count += 1
+        t0 = time.perf_counter()
+        host = jax.device_get(out)  # the one transfer per megastep
+        self.transfer_d2h_seconds += time.perf_counter() - t0
+
+        # --- host mirror reconciliation (megastep boundary) ----------
+        count = int(host["rows_added"])
+        # One chunk's rows (B * (T + n) worst case) must fit the ring
+        # for the mirror's slot arithmetic to stay 1:1 with surviving
+        # rows — same assumption as the sharded ring's ingest assert.
+        assert count <= self.cap, (
+            f"megastep ingested {count} rows into a {self.cap}-slot "
+            "ring in one scatter (shrink ROLLOUT_CHUNK_MOVES or grow "
+            "BUFFER_CAPACITY)"
+        )
+        slots = (buf._pos + np.arange(count)) % self.cap
+        if tree is not None and count:
+            # Fresh rows at the same pre-group watermark the device used.
+            tree.update_batch(slots, np.full(count, max_p))
+            tree.data_pointer = int((buf._pos + count) % self.cap)
+            tree.n_entries = min(buf._size + count, self.cap)
+        buf._pos = int((buf._pos + count) % self.cap)
+        buf._size = min(buf._size + count, self.cap)
+        # TD-error priority updates, in the same step order the device
+        # applied them.
+        if tree is not None:
+            for j in range(k):
+                buf.update_priorities(host["idx"][j], host["td"][j])
+
+        # --- engine-side stats (play_chunk's host tail) --------------
+        engine.last_trace = host["trace"]
+        engine._fold_episode_stats(host["episode"])
+        engine._total_simulations += (
+            int(host["trace"]["sims"].sum()) * engine.batch_size
+        )
+        # The megastep's version clock is the learner step (zero
+        # staleness); seed the harvest window tag with the group start.
+        engine._min_weights_version = (
+            start_step
+            if engine._min_weights_version is None
+            else min(engine._min_weights_version, start_step)
+        )
+        sentinels = int(host["sentinel_live"].sum())
+        if sentinels:
+            logger.warning(
+                "Megastep: %d zero-visit sentinel actions on LIVE games "
+                "(clamped to action 0).",
+                sentinels,
+            )
+
+        # --- trainer-side results (train_steps_finish contract) ------
+        trainer._host_step += k
+        results = []
+        for i in range(k):
+            m = {key: float(v[i]) for key, v in host["metrics"].items()}
+            m["learning_rate"] = float(trainer.schedule(start_step + i + 1))
+            results.append((m, np.asarray(host["td"][i])))
+        return results, count
+
+    # --- AOT warming / memory analysis (cli warm / cli fit) ---------------
+
+    def warm_megastep(
+        self, num_moves: int | None = None, k: int | None = None
+    ) -> bool:
+        """AOT-precompile the megastep program WITHOUT executing it (no
+        donation happens at lowering). True when an AOT executable is
+        ready; always False on CPU (cpu_aot bypass, reported as
+        skipped-cpu by `cli warm`)."""
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        k = int(k or max(1, self.config.FUSED_LEARNER_STEPS))
+        return self._megastep_fn(t, k).warm(*self._dispatch_args(t, k))
+
+    def analyze_megastep(
+        self, num_moves: int | None = None, k: int | None = None
+    ) -> "dict | None":
+        """Memory record of the megastep program at real dispatch avals
+        (telemetry/memory.py; `cli fit`) — AOT analysis only, nothing
+        executes. The record persists as a `.mem.json` sidecar in the
+        compile cache even on CPU, where the executable itself is
+        never serialized (cpu_aot bypass)."""
+        t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
+        k = int(k or max(1, self.config.FUSED_LEARNER_STEPS))
+        return self._megastep_fn(t, k).analyze(
+            *self._dispatch_args(t, k), persist=True
+        )

@@ -268,6 +268,9 @@ class SelfPlayEngine:
         # Lock-guarded: producer threads fetch concurrently.
         self.transfer_d2h_seconds = 0.0
         self._transfer_lock = threading.Lock()
+        # Rollout program dispatches (telemetry: the loop's dispatches-
+        # per-iteration gauge; lock-guarded with the transfer time).
+        self.dispatch_count = 0
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
 
@@ -571,6 +574,7 @@ class SelfPlayEngine:
         dt = time.perf_counter() - t0
         with self._transfer_lock:
             self.transfer_d2h_seconds += dt
+            self.dispatch_count += 1
         # Under playout cap randomization the per-move sim count varies;
         # the trace records what actually ran.
         self._total_simulations += (

@@ -109,6 +109,8 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         )
         self._cursors = np.zeros(dp, dtype=np.int64)
         self._sizes = np.zeros(dp, dtype=np.int64)
+        # Device program dispatches this ring made (telemetry gauge).
+        self.dispatch_count = 0
 
         from ..parallel.sharding import shard_map_compat
 
@@ -147,6 +149,7 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         self.storage, counts_dev = self._ingest_jit(
             self.storage, jnp.asarray(self._cursors, jnp.int32), blocks
         )
+        self.dispatch_count += 1
         counts = np.asarray(counts_dev)  # (dp,) — the one fetch
         # Host-side slot reconstruction below assumes each shard wrote
         # at most cap_local rows this ingest (slot uniqueness): a count

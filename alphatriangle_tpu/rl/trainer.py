@@ -180,6 +180,9 @@ class Trainer:
         # thread), so bare float accumulation is safe.
         self.transfer_h2d_seconds = 0.0
         self.transfer_d2h_seconds = 0.0
+        # Learner program dispatches (telemetry: the loop's dispatches-
+        # per-iteration gauge; one per step/group dispatch).
+        self.dispatch_count = 0
         mc = nn.model_config
         self.num_atoms = mc.NUM_VALUE_ATOMS
         self.v_min, self.v_max = mc.VALUE_MIN, mc.VALUE_MAX
@@ -504,6 +507,7 @@ class Trainer:
         device_batch = shard_batch(self.mesh, batch, self.dp_axis)
         self.transfer_h2d_seconds += time.perf_counter() - t0
         self.state, metrics, td = self._step_fn(self.state, device_batch)
+        self.dispatch_count += 1
         # ONE blocking transfer for everything this step produced
         # (fetching each metric separately costs a round trip apiece).
         t0 = time.perf_counter()
@@ -565,6 +569,7 @@ class Trainer:
             device_batch = shard_batch(self.mesh, batches[0], self.dp_axis)
             self.transfer_h2d_seconds += time.perf_counter() - t0
             self.state, metrics, td = self._step_fn(self.state, device_batch)
+            self.dispatch_count += 1
             handle: dict = {"k": 1, "metrics": metrics, "td": td}
         else:
             t0 = time.perf_counter()
@@ -588,6 +593,7 @@ class Trainer:
             self.state, metrics_k, td_k = self._multi_step_fn(
                 self.state, stacked
             )
+            self.dispatch_count += 1
             handle = {"k": len(batches), "metrics": metrics_k, "td": td_k}
         # The dispatch semantically runs the steps; advance the host
         # mirror now so LR lookups / buffer sampling for the NEXT group
@@ -634,6 +640,7 @@ class Trainer:
         self.state, metrics_k, td_k = from_fn(
             self.state, buffer.storage, idx, weights
         )
+        self.dispatch_count += 1
         handle = {
             "k": len(samples),
             "metrics": metrics_k,

@@ -370,6 +370,7 @@ def estimate_fit(
     train_config,
     fused_k: int = 4,
     device_replay: bool = False,
+    megastep: bool = False,
     progress=None,
 ) -> dict:
     """Build the run's hot programs AOT (lowered + compiled, never
@@ -379,7 +380,11 @@ def estimate_fit(
     device-replay gather program is not lowered here — lowering it
     needs the ring allocated, which is exactly the allocation a
     pre-flight must not make; the ring is accounted statically and the
-    gather's transient is bounded by the fused program's.
+    gather's transient is bounded by the fused program's. `megastep`
+    additionally analyzes the fused-megastep program (rl/megastep.py) —
+    this one DOES allocate the configured ring (its storage is a
+    program argument), so it is opt-in; `cli fit` enables it since its
+    bench-plan capacities are small.
     """
     from ..env.engine import TriangleEnv
     from ..features.core import get_feature_extractor
@@ -416,14 +421,35 @@ def estimate_fit(
     )
     chunk = train_config.ROLLOUT_CHUNK_MOVES
     lbatch = train_config.BATCH_SIZE
-    targets = (
+    targets = [
         (f"self_play_chunk/t{chunk}", lambda: engine.analyze_chunk(chunk)),
         (f"learner_step/b{lbatch}", lambda: trainer.analyze_step(lbatch)),
         (
             f"learner_fused/k{fused_k}",
             lambda: trainer.analyze_steps(fused_k, lbatch),
         ),
-    )
+    ]
+    if megastep:
+        from ..rl.device_buffer import DeviceReplayBuffer
+        from ..rl.megastep import MegastepRunner
+
+        mega_buffer = DeviceReplayBuffer(
+            train_config,
+            grid_shape=(
+                model_config.GRID_INPUT_CHANNELS,
+                env_config.ROWS,
+                env_config.COLS,
+            ),
+            other_dim=extractor.other_dim,
+            action_dim=env_config.action_dim,
+        )
+        runner = MegastepRunner(engine, trainer, mega_buffer, train_config)
+        targets.append(
+            (
+                f"megastep/t{chunk}_k{fused_k}",
+                lambda: runner.analyze_megastep(chunk, fused_k),
+            )
+        )
     for label, fn in targets:
         t0 = time.time()
         try:

@@ -107,6 +107,8 @@ class UtilizationMeter:
         compile_hits: int = 0,
         compile_misses: int = 0,
         device_memory: "list | None" = None,
+        dispatches: int = 0,
+        iterations: int = 0,
     ) -> "dict | None":
         """One derived utilization record, or None (first/zero-width tick)."""
         now = self._clock()
@@ -121,6 +123,8 @@ class UtilizationMeter:
             "simulations": simulations,
             "transfer_h2d_s": transfer_h2d_s,
             "transfer_d2h_s": transfer_d2h_s,
+            "dispatches": dispatches,
+            "iterations": iterations,
         }
         prev, self._prev = self._prev, {"t": now, **cur}
         if prev is None:
@@ -183,6 +187,16 @@ class UtilizationMeter:
             "compile_cache_hit_rate": (
                 round(compile_hits / total_compiles, 4)
                 if total_compiles
+                else None
+            ),
+            # Device-program dispatches per loop iteration: the host-
+            # round-trip gauge the fused megastep exists to collapse to
+            # 1.0 (sync runs ~3: rollout + ingest + learner group).
+            "dispatches_per_iteration": (
+                round(
+                    max(0, d["dispatches"]) / d["iterations"], 3
+                )
+                if d["iterations"] > 0
                 else None
             ),
         }
@@ -309,6 +323,7 @@ def summarize_utilization(
         "transfer_h2d_ms": _mean(col("transfer_h2d_ms")),
         "transfer_d2h_ms": _mean(col("transfer_d2h_ms")),
         "compile_cache_hit_rate": last.get("compile_cache_hit_rate"),
+        "dispatches_per_iteration": _mean(col("dispatches_per_iteration")),
         # Memory (telemetry/memory.py): run-wide observed peak, plus
         # the newest in-use/limit snapshot for the `cli perf` readout.
         "mem_peak_bytes_in_use": (

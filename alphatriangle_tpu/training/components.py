@@ -46,4 +46,9 @@ class TrainingComponents:
     telemetry: RunTelemetry | None = None
     telemetry_config: TelemetryConfig | None = None
 
+    # Fused-megastep runner (rl/megastep.py), built by setup when
+    # TrainConfig.FUSED_MEGASTEP; the loop constructs one lazily for
+    # manually assembled components.
+    megastep: Any = None
+
     extra: dict[str, Any] = field(default_factory=dict)

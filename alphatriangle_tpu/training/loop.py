@@ -1,6 +1,6 @@
 """The training loop (reference `training/loop.py:23-416`).
 
-Two orchestration modes over device-batched self-play:
+Three orchestration modes over device-batched self-play:
 
 - **Synchronous** (default): each iteration plays a rollout chunk
   (`ROLLOUT_CHUNK_MOVES` moves of all `SELF_PLAY_BATCH_SIZE` games),
@@ -14,6 +14,12 @@ Two orchestration modes over device-batched self-play:
   (`training/loop.py:298-416`, `worker_manager.py:106-167`)
   re-expressed for one process; queue depth and achieved replay ratio
   are exported as gauges.
+- **Fused megastep** (`FUSED_MEGASTEP=True`, rl/megastep.py): rollout
+  chunk + device-ring ingest + on-device PER sampling + K fused
+  learner steps as ONE device program per iteration (Anakin,
+  arXiv:2104.06272) — one dispatch, one stats fetch, zero-staleness
+  weights. The dispatches-per-iteration gauge (telemetry/perf.py)
+  makes the difference visible across all three modes.
 
 Cadences are parity knobs:
 weight sync every `WORKER_UPDATE_FREQ_STEPS` learner steps
@@ -80,6 +86,14 @@ class TrainingLoop:
         # but not yet fetched, oldest first. Each entry is
         # (trainer handle, samples list).
         self._inflight: deque = deque()
+        # Fused-megastep bookkeeping: the runner (setup-built or lazily
+        # created), steady-state iteration count (one device dispatch
+        # each — the counter the megastep tests assert on), and the
+        # loop-wide iteration counter feeding the dispatches-per-
+        # iteration gauge in every mode.
+        self._megastep_runner = components.megastep
+        self.megastep_iterations = 0
+        self.iterations = 0
         # Async chunk auto-tune: producers publish one shared tuned
         # move count (first accurate measurement wins).
         self._tune_lock = threading.Lock()
@@ -145,17 +159,22 @@ class TrainingLoop:
         )
         return self._fold_result(result, payload=payload)
 
-    def _fold_result(self, result, trace=None, payload=None) -> int:
+    def _fold_result(self, result, trace=None, payload=None, added=None) -> int:
         """Fold one self-play harvest into the buffer + metrics.
 
         `trace` is the producing engine's per-chunk diagnostics; when
         None (sync mode, single producer) the primary engine's
         `last_trace` is read directly. `payload` is the device-resident
         experience block in device-replay mode (scattered into the
-        on-device ring; `result` then carries stats only).
+        on-device ring; `result` then carries stats only). `added`
+        short-circuits the buffer write entirely — megastep mode, where
+        the rows were already scattered in-program and only the count
+        came back.
         """
         c = self.c
-        if payload is not None:
+        if added is not None:
+            pass  # rows landed in the device ring inside the megastep
+        elif payload is not None:
             added = c.buffer.ingest_payload(payload)
         else:
             c.buffer.add_dense(
@@ -204,10 +223,10 @@ class TrainingLoop:
                 RawMetricEvent(
                     name="SelfPlay/Staleness_Steps",
                     value=(
-                        c.net.weights_version
+                        self._version_clock()
                         - float(np.mean(result.episode_start_versions))
                         if result.episode_start_versions
-                        else c.net.weights_version
+                        else self._version_clock()
                         - result.trainer_step_at_episode_start
                     ),
                     global_step=step,
@@ -263,15 +282,28 @@ class TrainingLoop:
         self.telemetry.on_rollout(added, result.num_episodes)
         return added
 
+    def _version_clock(self) -> int:
+        """The weights-version clock staleness is measured against:
+        the eval wrapper's sync version normally, the learner step in
+        megastep mode (episodes there are tagged with the live step —
+        zero-staleness by construction, and `net.weights_version` only
+        advances at the unrelated sync cadence)."""
+        if self.cfg.FUSED_MEGASTEP:
+            return self.c.trainer.global_step
+        return self.c.net.weights_version
+
     def _record_step(self, metrics: dict, td_errors, indices, step: int) -> None:
         """Per-learner-step bookkeeping: priorities, counters, events.
 
         `step` is the learner step this result belongs to — within a
         fused group the trainer's counter is already at the group end,
-        so events must carry their own per-step x-value.
+        so events must carry their own per-step x-value. `indices` is
+        None in megastep mode: the runner already reconciled the host
+        PER mirror from the device program's sampled slots.
         """
         c = self.c
-        c.buffer.update_priorities(indices, td_errors)
+        if indices is not None:
+            c.buffer.update_priorities(indices, td_errors)
         self.global_step = step
         self._steps_this_run += 1
         events = [
@@ -538,7 +570,9 @@ class TrainingLoop:
         status = LoopStatus.COMPLETED
         self.telemetry.start()
         try:
-            if self.cfg.ASYNC_ROLLOUTS:
+            if self.cfg.FUSED_MEGASTEP:
+                self._run_megastep_mode()
+            elif self.cfg.ASYNC_ROLLOUTS:
                 self._run_async()
             else:
                 self._run_sync()
@@ -583,6 +617,71 @@ class TrainingLoop:
                 1, round(added / cfg.BATCH_SIZE)
             )
             self._run_training_steps(n_steps)
+            self._iteration_tail()
+
+    # --- fused megastep (Anakin) ------------------------------------------
+
+    def _run_megastep_mode(self) -> None:
+        """One device program per iteration: rollout chunk + ring
+        ingest + on-device sampling + K learner steps (rl/megastep.py).
+
+        Warm-up is host-orchestrated (rollout + ingest, no training)
+        until the ring can produce a batch — the megastep program
+        always trains, so dispatching it against a not-ready ring would
+        sample garbage rows. From then on, ONE dispatch and ONE stats
+        fetch per iteration; `megastep_iterations` vs the runner's
+        `dispatch_count` is the counter the tests assert equal.
+        """
+        cfg = self.cfg
+        runner = self._megastep_runner
+        if runner is None:
+            from ..rl.megastep import MegastepRunner
+
+            runner = MegastepRunner(
+                self.c.self_play, self.c.trainer, self.c.buffer, cfg
+            )
+            self.c.megastep = self._megastep_runner = runner
+        need = max(cfg.MIN_BUFFER_SIZE_TO_TRAIN, cfg.BATCH_SIZE)
+        iteration = 0
+        while not self.stop_event.is_set() and len(self.c.buffer) < need:
+            self.profile.on_iteration(iteration)
+            iteration += 1
+            with self.profile.phase("rollout"):
+                self._process_rollout()
+            self._iteration_tail()
+        # Device priorities pick up everything the warmup (and any
+        # checkpoint restore before it) wrote into the host mirror.
+        runner.sync_priorities_from_host()
+        k_cfg = cfg.LEARNER_STEPS_PER_ROLLOUT or max(
+            1, cfg.FUSED_LEARNER_STEPS
+        )
+        while not self.stop_event.is_set():
+            if self._max_steps_reached():
+                logger.info(
+                    "Reached MAX_TRAINING_STEPS=%d.", cfg.MAX_TRAINING_STEPS
+                )
+                break
+            # Tail groups shrink K to the remaining budget (a per-(T,K)
+            # program compiles once, same contract as the fused paths).
+            k = self._learner_budget(k_cfg)
+            if k <= 0:
+                break
+            self.profile.on_iteration(iteration)
+            iteration += 1
+            prev_step = self.global_step
+            with self.profile.phase("megastep"):
+                outs, added = runner.run_megastep(
+                    cfg.ROLLOUT_CHUNK_MOVES, k
+                )
+            self.megastep_iterations += 1
+            self._fold_result(self.c.self_play.harvest(), added=added)
+            for i, (metrics, td_errors) in enumerate(outs):
+                self._record_step(
+                    metrics, td_errors, None, prev_step + i + 1
+                )
+            self._maybe_sync_weights(prev_step)
+            with self.profile.phase("checkpoint"):
+                self._maybe_checkpoint()
             self._iteration_tail()
 
     # --- overlapped producer/consumer ------------------------------------
@@ -1056,7 +1155,29 @@ class TrainingLoop:
             float(getattr(e, "transfer_d2h_seconds", 0.0))
             for e in engines.values()
         )
+        if self._megastep_runner is not None:
+            d2h += float(self._megastep_runner.transfer_d2h_seconds)
         return h2d, d2h
+
+    def _total_dispatches(self) -> int:
+        """Cumulative device-program dispatches across every component
+        (rollout engines, learner, ring ingest, megastep) — the
+        numerator of the dispatches-per-iteration gauge that makes the
+        megastep's one-dispatch iteration visible in `cli perf`."""
+        c = self.c
+        total = int(getattr(c.trainer, "dispatch_count", 0))
+        total += int(getattr(c.buffer, "dispatch_count", 0))
+        engines = {id(c.self_play): c.self_play}
+        for rec in self._streams.values():
+            engine = rec.get("engine")
+            if engine is not None:
+                engines[id(engine)] = engine
+        total += sum(
+            int(getattr(e, "dispatch_count", 0)) for e in engines.values()
+        )
+        if self._megastep_runner is not None:
+            total += int(self._megastep_runner.dispatch_count)
+        return total
 
     def _iteration_tail(self) -> None:
         if self.cfg.PROFILE_WORKERS:
@@ -1066,6 +1187,7 @@ class TrainingLoop:
         # heartbeat write (health.json) — before the stats tick so any
         # Anomaly/* or Health/* events logged this iteration flush too.
         h2d, d2h = self._transfer_seconds()
+        self.iterations += 1
         self.telemetry.on_util_tick(
             self.global_step,
             episodes=self.episodes_played,
@@ -1074,6 +1196,8 @@ class TrainingLoop:
             buffer_size=len(self.c.buffer),
             transfer_h2d_s=h2d,
             transfer_d2h_s=d2h,
+            dispatches=self._total_dispatches(),
+            iterations=self.iterations,
         )
         self.telemetry.on_tick(self.global_step, len(self.c.buffer))
         self.c.stats.process_and_log(self.global_step)

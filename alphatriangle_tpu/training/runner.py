@@ -75,7 +75,7 @@ def run_training(
     # Must precede any backend init (a site hook can override the env
     # var and point a CPU-intended run at a possibly-wedged TPU).
     enforce_platform(train_config.DEVICE)
-    if train_config.DEVICE_REPLAY == "on":
+    if train_config.DEVICE_REPLAY == "on" or train_config.FUSED_MEGASTEP:
         # Forced device replay may land on the CPU backend (tests,
         # smokes). XLA:CPU's async dispatch deadlocks under the
         # device-replay thread topology, and the flag is latched at CPU

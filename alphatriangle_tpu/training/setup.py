@@ -113,6 +113,15 @@ def _make_buffer(
     )
     mode = train_config.DEVICE_REPLAY
     single = jax.process_count() == 1 and mesh.devices.size == 1
+    if train_config.FUSED_MEGASTEP and not single:
+        # The megastep program samples and trains against the ONE
+        # device-resident ring; a dp-sharded megastep (per-device rings
+        # + shard_map sampling) is future work (docs/PARALLELISM.md).
+        raise ValueError(
+            "FUSED_MEGASTEP needs a single-device, single-process mesh "
+            f"(got {dict(mesh.shape)}, {jax.process_count()} "
+            "processes)."
+        )
     # First axis is data-parallel by convention (MeshConfig.build_mesh).
     dp = mesh.shape[mesh.axis_names[0]]
     sharded_ok = (
@@ -126,7 +135,14 @@ def _make_buffer(
         # a single-device engine's payload would crash the scatter.
         and train_config.SELF_PLAY_BATCH_SIZE % dp == 0
     )
-    want = mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
+    want = (
+        mode == "on"
+        or (mode == "auto" and jax.default_backend() != "cpu")
+        # Megastep requires the device ring wherever it runs (the CPU
+        # backend included — the smoke/parity tier), exactly like an
+        # explicit "on".
+        or train_config.FUSED_MEGASTEP
+    )
     if mode == "on" and not (single or sharded_ok):
         # An explicit force that can't be honored must not silently
         # substitute the other code path.
@@ -286,6 +302,23 @@ def setup_training_components(
         mesh=sp_mesh,
         data_axes=sp_axes or ("dp",),
     )
+    # Fused megastep (rl/megastep.py): one device program per iteration
+    # runs rollout + ingest + on-device PER sampling + K learner steps;
+    # the runner binds the engine/trainer/ring triple built above.
+    megastep_runner = None
+    if train_config.FUSED_MEGASTEP:
+        from ..rl.megastep import MegastepRunner
+
+        megastep_runner = MegastepRunner(
+            self_play, trainer, buffer, train_config
+        )
+        logger.info(
+            "Fused megastep mode: %d moves + %d learner steps per "
+            "device dispatch.",
+            train_config.ROLLOUT_CHUNK_MOVES,
+            train_config.LEARNER_STEPS_PER_ROLLOUT
+            or max(1, train_config.FUSED_LEARNER_STEPS),
+        )
     # TensorBoard and the live-console JSONL are singleton host-side
     # work: process 0 only (N processes appending one shared file would
     # interleave diverging step/episode lines and corrupt `cli watch`'s
@@ -411,4 +444,5 @@ def setup_training_components(
         persistence_config=persistence_config,
         telemetry=telemetry,
         telemetry_config=telemetry_config,
+        megastep=megastep_runner,
     )

@@ -138,6 +138,30 @@ def warm_bench_programs(
                     ),
                 )
             )
+    # Fused megastep (rl/megastep.py): the whole iteration as one
+    # program. Contains learner steps, so it is CPU-bypassed like the
+    # learner family (row reports skipped-cpu there); the runner/ring
+    # are only constructed when the warm will actually run.
+    mega_fn = None
+    if trainer.aot_enabled:
+        from .rl.device_buffer import DeviceReplayBuffer
+        from .rl.megastep import MegastepRunner
+
+        mega_buffer = DeviceReplayBuffer(
+            plan.train,
+            grid_shape=(
+                plan.model.GRID_INPUT_CHANNELS,
+                plan.env.ROWS,
+                plan.env.COLS,
+            ),
+            other_dim=extractor.other_dim,
+            action_dim=plan.env.action_dim,
+        )
+        runner = MegastepRunner(engine, trainer, mega_buffer, plan.train)
+        mega_fn = lambda: runner.warm_megastep(plan.chunk, plan.fused_k)
+    targets.append(
+        (f"megastep/t{plan.chunk}_k{plan.fused_k}", mega_fn)
+    )
     if programs:
         targets = [
             (name, fn)

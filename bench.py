@@ -619,6 +619,18 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             with lock:
                 produced["errors"].append(f"{type(exc).__name__}: {exc}")
 
+    def dispatch_total(*comps) -> int:
+        """Cumulative device-program dispatches across components (the
+        per-mode dispatches-per-iteration counter; rl components each
+        count their own dispatches)."""
+        seen = {}
+        for comp in comps:
+            if comp is not None:
+                seen[id(comp)] = comp
+        return sum(
+            int(getattr(x, "dispatch_count", 0)) for x in seen.values()
+        )
+
     threads = [
         threading.Thread(target=producer, args=(e,), daemon=True)
         for e in engines
@@ -628,8 +640,11 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     t0 = time.time()
     o_steps = 0
     o_ingested = 0
+    o_iters = 0
+    o_disp0 = dispatch_total(trainer, dev_buffer, *engines)
     pending = None
     while time.time() - t0 < overlap_seconds:
+        o_iters += 1
         if payloads is not None:
             assert dev_buffer is not None
             while True:
@@ -659,11 +674,18 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     else:
         o_episodes = sum(e.harvest().num_episodes for e in engines)
     o_games_per_hour = o_episodes / o_elapsed * 3600.0
+    o_moves_per_sec = produced["moves"] * sp_batch / o_elapsed
+    o_dpi = (
+        dispatch_total(trainer, dev_buffer, *engines) - o_disp0
+    ) / max(o_iters, 1)
     overlapped = {
         "seconds": round(o_elapsed, 1),
         "streams": n_streams,
         "chunk_moves": async_chunk,
         "fused_group": overlap_k,
+        # Device dispatches per consumer pump beat — the host-round-
+        # trip count the fused megastep collapses to 1.
+        "dispatches_per_iteration": round(o_dpi, 2),
         "games_per_hour": round(o_games_per_hour, 1),
         "vs_serialized_self_play": round(
             o_games_per_hour / games_per_hour, 3
@@ -684,6 +706,118 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         overlapped["producer_errors"] = produced["errors"]
     log(f"bench: overlapped {overlapped}")
     extra["overlapped"] = overlapped
+    emit(snapshot("overlapped"))
+
+    # --- fused megastep (Anakin): the whole iteration as ONE program ----
+    # rollout chunk + ring ingest + on-device PER sampling + K learner
+    # steps in a single jitted dispatch (rl/megastep.py) — the loop's
+    # FUSED_MEGASTEP mode. vs_overlapped is the headline: the round-5
+    # overlapped mode ran at 0.774x of serialized self-play because
+    # every phase paid a host round trip; the megastep removes them.
+    # BENCH_MEGASTEP=0 skips the section (compile-budget escape hatch).
+    if os.environ.get("BENCH_MEGASTEP", "1") != "0":
+        from alphatriangle_tpu.rl.device_buffer import DeviceReplayBuffer
+        from alphatriangle_tpu.rl.megastep import MegastepRunner
+
+        mega_buffer = dev_buffer
+        if mega_buffer is None:
+            # CPU/smoke path: the device-replay learner section didn't
+            # run, so build + prefill the ring here (DEVICE_REPLAY="on"
+            # works on the CPU backend; this section is single-threaded
+            # so the XLA:CPU async-dispatch caveat does not apply).
+            mega_buffer = DeviceReplayBuffer(
+                train_cfg,
+                grid_shape=(
+                    model_cfg.GRID_INPUT_CHANNELS,
+                    env_cfg.ROWS,
+                    env_cfg.COLS,
+                ),
+                other_dim=extractor.other_dim,
+                action_dim=env_cfg.action_dim,
+            )
+            fill = batch["grid"].astype(np.int8).astype(np.float32)
+            for _ in range(
+                max(1, (train_cfg.MIN_BUFFER_SIZE_TO_TRAIN // b) + 1)
+            ):
+                mega_buffer.add_dense(
+                    fill,
+                    batch["other_features"],
+                    batch["policy_target"],
+                    batch["value_target"],
+                )
+        runner = MegastepRunner(engine, trainer, mega_buffer, train_cfg)
+        mega_k = fused_k
+        engine.harvest()  # drop pre-section episode stats
+        log(
+            f"bench: compiling megastep t{chunk}_k{mega_k} "
+            "(first dispatch)..."
+        )
+        t0 = time.time()
+        runner.run_megastep(chunk, mega_k)
+        mega_compile_s = time.time() - t0
+        engine.harvest()
+        mega_seconds = 5.0 if smoke else min(30.0, seconds)
+        m_disp0 = dispatch_total(
+            trainer, dev_buffer, mega_buffer, runner, *engines
+        )
+        t0 = time.time()
+        m_moves = 0
+        m_steps = 0
+        m_iters = 0
+        while time.time() - t0 < mega_seconds:
+            runner.run_megastep(chunk, mega_k)
+            m_moves += chunk
+            m_steps += mega_k
+            m_iters += 1
+        m_elapsed = time.time() - t0
+        m_dpi = (
+            dispatch_total(
+                trainer, dev_buffer, mega_buffer, runner, *engines
+            )
+            - m_disp0
+        ) / max(m_iters, 1)
+        m_result = engine.harvest()
+        m_games_per_hour = m_result.num_episodes / m_elapsed * 3600.0
+        m_moves_per_sec = m_moves * sp_batch / m_elapsed
+        m_steps_per_sec = m_steps / m_elapsed
+        # vs_overlapped: games/h when both windows completed episodes,
+        # else the exact moves/s ratio (short smoke windows may finish
+        # zero episodes; the ratio must still land — acceptance bar).
+        if o_games_per_hour > 0 and m_games_per_hour > 0:
+            vs_overlapped = m_games_per_hour / o_games_per_hour
+            vs_basis = "games_per_hour"
+        else:
+            vs_overlapped = (
+                m_moves_per_sec / o_moves_per_sec
+                if o_moves_per_sec > 0
+                else None
+            )
+            vs_basis = "moves_per_sec"
+        megastep_section = {
+            "seconds": round(m_elapsed, 1),
+            "iterations": m_iters,
+            "chunk_moves": chunk,
+            "learner_steps_per_iteration": mega_k,
+            "compile_seconds": round(mega_compile_s, 1),
+            "games_per_hour": round(m_games_per_hour, 1),
+            "moves_per_sec": round(m_moves_per_sec, 1),
+            "learner_steps_per_sec": round(m_steps_per_sec, 2),
+            "vs_overlapped": (
+                round(vs_overlapped, 3) if vs_overlapped else None
+            ),
+            "vs_overlapped_basis": vs_basis,
+            # All three loop modes' host-round-trip gauges side by
+            # side (the overlapped/megastep values are measured; the
+            # sync loop's is fixed by construction: rollout + ingest +
+            # one fused learner group per iteration).
+            "dispatches_per_iteration": {
+                "sync": 3.0,
+                "overlapped": round(o_dpi, 2),
+                "megastep": round(m_dpi, 2),
+            },
+        }
+        log(f"bench: megastep {megastep_section}")
+        extra["megastep"] = megastep_section
     log(f"bench: flops/mfu {extra['flops']}")
     return snapshot(None)
 

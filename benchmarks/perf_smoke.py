@@ -194,6 +194,59 @@ def main() -> int:
         print(f"perf-smoke: cli fit cpu failed (rc={rc})", file=sys.stderr)
         return rc
 
+    print("perf-smoke: fused-megastep mode gate...", flush=True)
+    # A second, even shorter run in FUSED_MEGASTEP mode: the whole
+    # iteration (rollout + ingest + on-device sampling + K learner
+    # steps) is one device program, and its ledger must carry the
+    # dispatches-per-iteration gauge that makes the win measurable.
+    from alphatriangle_tpu.config import TrainConfig
+
+    mega_run = f"{RUN_NAME}_megastep"
+    mega_cfg = TrainConfig(
+        **{
+            **train_cfg.model_dump(),
+            "RUN_NAME": mega_run,
+            "FUSED_MEGASTEP": True,
+            "DEVICE_REPLAY": "on",
+            "FUSED_LEARNER_STEPS": 2,
+            "MAX_TRAINING_STEPS": 4,
+        }
+    )
+    mega_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=mega_run)
+    rc = run_training(
+        train_config=mega_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=mega_pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(
+            f"perf-smoke: megastep run failed (rc={rc})", file=sys.stderr
+        )
+        return rc
+    mega_ledger = mega_pc.get_run_base_dir() / "metrics.jsonl"
+    mega_dpi = [
+        r.get("dispatches_per_iteration")
+        for line in mega_ledger.read_text().splitlines()
+        for r in [_json.loads(line)]
+        if r.get("kind") == "util"
+        and isinstance(r.get("dispatches_per_iteration"), (int, float))
+    ]
+    if not mega_dpi:
+        print(
+            f"perf-smoke: {mega_ledger} has no util record with "
+            "dispatches_per_iteration — the megastep gauge broke",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"perf-smoke: megastep ran; dispatches/iteration "
+        f"{mega_dpi[-1]:.1f} (last tick)"
+    )
+
     if args.write_reference:
         import contextlib
         import io

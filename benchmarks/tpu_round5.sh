@@ -7,6 +7,17 @@ cd "$(dirname "$0")/.."
 out=benchmarks/tpu_r5_results.jsonl
 run() {
   label="$1"; shift
+  # ORCH_END_BY (epoch seconds, exported by the orchestrator): re-check
+  # the hard deadline BETWEEN sections — a section launched with too
+  # little runway would hold the chip past the deadline and collide
+  # with the round driver's own bench (the contention the deadline
+  # contract exists to prevent). 120s floor: less than that cannot fit
+  # even a probe, let alone a measurement.
+  if [ "${ORCH_END_BY:-0}" -gt 0 ] && \
+     [ $(( ORCH_END_BY - $(date +%s) )) -lt 120 ]; then
+    echo "sweep: out of runway before $label; stopping cleanly" >&2
+    exit 0
+  fi
   # BENCH_SECTIONS="a b c": run only the named sections (the
   # orchestrator uses this to land the highest-priority numbers before
   # handing the chip to the hours-long training run).

@@ -26,7 +26,13 @@ runway() {
 
 KEY="flagship_gumbel_pcr flagship_puct preset2 preset4"
 [ "$(runway)" -gt 600 ] || { echo "orchestrator: out of runway" >&2; exit 1; }
-BENCH_SECTIONS="$KEY" bash benchmarks/tpu_round5.sh || exit 1
+# Capped by the remaining runway like every other phase: even the
+# "minutes each" key sections can stack past ORCH_END_BY when several
+# retry their probe budgets back to back (ADVICE round-5). The sweep
+# also re-checks ORCH_END_BY between sections, so TERM here is a
+# backstop, not the usual exit path.
+BENCH_SECTIONS="$KEY" timeout $(( $(runway) - 60 )) \
+  bash benchmarks/tpu_round5.sh || exit 1
 
 r=$(runway)
 if [ "$r" -gt 1800 ]; then

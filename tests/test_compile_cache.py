@@ -429,7 +429,12 @@ class TestWarmCLI:
             aot = [p for p, s in statuses.items() if s == "aot"]
             skipped = [p for p, s in statuses.items() if s == "skipped-cpu"]
             assert aot and all(p.startswith("self_play") for p in aot)
-            assert skipped and all(p.startswith("learner") for p in skipped)
+            # The learner family AND the megastep (which embeds learner
+            # steps) are CPU-bypassed.
+            assert skipped and all(
+                p.startswith(("learner", "megastep")) for p in skipped
+            )
+            assert any(p.startswith("megastep") for p in skipped)
             assert set(statuses.values()) == {"aot", "skipped-cpu"}
             assert report["stats"]["misses"] == len(aot)
 
