@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke
 
 check: lint type test
 
@@ -53,3 +53,14 @@ bench-smoke:
 #   $(PY) benchmarks/perf_smoke.py --write-reference
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/perf_smoke.py
+
+# Policy-serving pipeline gate (docs/SERVING.md): `cli serve --smoke`
+# must serve >= 64 concurrent simulated sessions on CPU through batched
+# search dispatches with admit/retire churn mid-run, land per-request
+# p50/p95 move-latency records in the serve run's metrics ledger,
+# summarize them via `cli perf --json`, and hold the serve SLO rows of
+# `cli compare` against the checked-in reference. Regenerate the serve
+# rows after intentional schema changes:
+#   $(PY) benchmarks/serve_smoke.py --write-reference
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_smoke.py

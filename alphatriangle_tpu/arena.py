@@ -7,11 +7,30 @@ shape draws depend only on the step index (the key chain splits every
 step regardless of action), so game i sees the same hand sequence under
 every policy — comparisons are paired, stripping the hand-luck variance
 that dominates this game.
+
+Arena play is a CLIENT of the serving session API
+(serving/session.py): games are admitted into a `SessionSlots` array
+and stepped through the same masked lockstep path the policy service
+dispatches — eval/arena traffic and served "human" traffic exercise
+one code path. `play` drives an arbitrary `policy_fn` over the slot
+states directly; `play_service` drives paired games through the full
+`PolicyService` queue/dispatch path (the route `cli eval` and the Elo
+ladder take for search policies). Lane isolation (see session.py)
+is what makes the two produce identical trajectories.
+
+Termination is checked every `termination_check_every` moves instead
+of every move: the per-move `states.done -> NumPy` sync was a host
+round trip per move; stepping all-done lanes is a frozen no-op, so the
+deferred check trades a handful of inert dispatches at the end of a
+run for a sync-free steady state. Results are bit-identical for any
+check interval (test_arena pins this with a fixed seed).
 """
 
 from collections.abc import Callable
 
 import numpy as np
+
+TERMINATION_CHECK_EVERY = 8
 
 
 def play(
@@ -20,41 +39,101 @@ def play(
     games: int,
     max_moves: int,
     seed: int,
+    termination_check_every: int = TERMINATION_CHECK_EVERY,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Roll `games` paired hands under `policy_fn(states, move) -> (B,)
     actions`; returns (scores, lengths, done) as NumPy arrays."""
     import jax
     import jax.numpy as jnp
 
-    states = env.reset_batch(jax.random.split(jax.random.PRNGKey(seed), games))
+    from .serving.session import SessionSlots
+
+    slots = SessionSlots(env, games)
+    slots.admit_many(jax.random.split(jax.random.PRNGKey(seed), games))
+    mask = np.ones(games, dtype=bool)
     for move in range(max_moves):
-        if bool(np.asarray(states.done).all()):
+        if move % termination_check_every == 0 and bool(
+            np.asarray(slots.states.done).all()
+        ):
             break
-        actions = policy_fn(states, move)
-        states, _, _ = env.step_batch(
-            states, jnp.asarray(actions, dtype=jnp.int32)
+        actions = policy_fn(slots.states, move)
+        slots.step(jnp.asarray(actions, dtype=jnp.int32), mask)
+    return slots.host_results()
+
+
+def play_service(
+    service,
+    games: int,
+    max_moves: int,
+    seed: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Paired arena play through the policy service's request-queue +
+    dispatch path; same contract and same results as
+    `play(env, greedy_mcts_policy(net, mcts), ...)` when the service
+    wraps that (net, mcts) — the dispatch keys reproduce
+    `greedy_mcts_policy`'s `PRNGKey(7000 + move)` chain and lane
+    isolation keeps per-game trajectories independent of churn.
+
+    The service must have at least `games` free slots; sessions are
+    retired as their games finish (the service's churn path, exercised
+    by every eval)."""
+    import jax
+
+    if service.sessions.free_count < games:
+        raise RuntimeError(
+            f"play_service: {games} games need {games} free slots; "
+            f"only {service.sessions.free_count} of "
+            f"{service.sessions.slots} free"
         )
-    return (
-        np.asarray(states.score),
-        np.asarray(states.step_count),
-        np.asarray(states.done),
+    sessions = service.open_sessions(
+        jax.random.split(jax.random.PRNGKey(seed), games)
     )
+    order = {s.sid: i for i, s in enumerate(sessions)}
+    scores = np.zeros(games, dtype=np.float32)
+    lengths = np.zeros(games, dtype=np.int32)
+    done = np.zeros(games, dtype=bool)
+
+    def close(sid: int) -> None:
+        i = order[sid]
+        summary = service.close_session(sid)
+        scores[i] = summary["score"]
+        lengths[i] = summary["moves"]
+        done[i] = summary["done"]
+
+    for s in sessions:
+        service.request_move(s.sid)
+    move = 0
+    live = games
+    while live > 0 and move < max_moves:
+        results = service.dispatch(rng=jax.random.PRNGKey(7000 + move))
+        move += 1
+        for r in results:
+            if r["done"] or move >= max_moves:
+                close(r["sid"])
+                live -= 1
+            else:
+                service.request_move(r["sid"])
+    # Truncated stragglers (max_moves reached mid-queue).
+    for s in list(service.sessions.live_sessions()):
+        if s.sid in order:
+            close(s.sid)
+    return scores, lengths, done
 
 
 def greedy_mcts_policy(net, mcts, use_gumbel: bool = False) -> Callable:
     """Deterministic play from a search: visit-count argmax (PUCT) or
     the final-candidate selection (Gumbel exploit mode). Reads
     `net.variables` at call time, so one compiled search serves any
-    number of weight restores."""
+    number of weight restores — the hot-reload property the policy
+    service leans on (serving/service.py)."""
     import jax
+
+    from .mcts.helpers import select_root_actions
 
     def policy(states, move):
         out = mcts.search(
             net.variables, states, jax.random.PRNGKey(7000 + move)
         )
-        if use_gumbel:
-            return np.maximum(np.asarray(out.selected_action), 0)
-        counts = np.asarray(out.visit_counts)
-        return np.where(counts.sum(axis=1) > 0, counts.argmax(axis=1), 0)
+        return select_root_actions(out, use_gumbel=use_gumbel)
 
     return policy

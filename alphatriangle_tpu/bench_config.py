@@ -32,6 +32,11 @@ class BenchPlan:
     fused_k: int = 4
     overlap_k: int = 4
     device_replay: bool = False
+    # Policy-service slot count (serving/service.py): the compiled
+    # `serve/b<B>` search shape `cli warm` precompiles, `cli fit
+    # --serve` analyzes, and bench's serve section measures. Defaults
+    # to the scale's self-play lane count (same MXU-batch family).
+    serve_batch: int = 0
     extras: dict = field(default_factory=dict)
 
 
@@ -219,6 +224,10 @@ def resolve_bench_plan(
     fused_k = 4 if (smoke or backend == "cpu") else 16
     overlap_k = fused_k if (smoke or backend == "cpu") else 64
     device_replay = backend != "cpu" and not smoke
+    # Serve slot count: the self-play lane count unless overridden
+    # (BENCH_SERVE_SLOTS) — one compiled search shape shared between
+    # the rollout's search and the policy service's.
+    serve_batch = int(env.get("BENCH_SERVE_SLOTS") or sp_batch)
     return BenchPlan(
         env=env_cfg,
         model=model_cfg,
@@ -233,4 +242,5 @@ def resolve_bench_plan(
         fused_k=fused_k,
         overlap_k=overlap_k,
         device_replay=device_replay,
+        serve_batch=serve_batch,
     )

@@ -642,6 +642,17 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   limit {_fmt_bytes(summary.get('mem_bytes_limit'))}"
             f"   est budget {_fmt_bytes(mem_budget)} (cli mem)"
         )
+    if summary.get("serve_move_latency_ms_p95") is not None:
+        # Policy-service SLO line (serving/service.py; docs/SERVING.md):
+        # p50 averages tick windows, p95 is the WORST window.
+        print(
+            f"  serving      move p50 {_fmt_cell(summary.get('serve_move_latency_ms_p50'), ',.1f', 1, 'ms')}"
+            f"   p95 {_fmt_cell(summary.get('serve_move_latency_ms_p95'), ',.1f', 1, 'ms')}"
+            f"   wait p95 {_fmt_cell(summary.get('serve_queue_wait_ms_p95'), ',.1f', 1, 'ms')}"
+            f"   {_fmt_cell(summary.get('serve_requests_per_sec'), ',.1f')} req/s"
+            f"   fill {_fmt_cell(summary.get('serve_batch_fill'), ',.0f', 100.0, '%')}"
+            f"   reloads {_fmt_cell(summary.get('serve_weight_reloads'), ',.0f')}"
+        )
     print(
         f"  trend        {_fmt_cell(trend, '+,.1f', 100.0, '%')} "
         "(2nd-half vs 1st-half throughput)"
@@ -665,7 +676,14 @@ def cmd_compare(args: argparse.Namespace) -> int:
             print(f"compare: side {side}: {label}", file=sys.stderr)
     if a is None or b is None:
         return 2
-    rows, regressions = compare_summaries(a, b, threshold=args.threshold)
+    metrics = (
+        tuple(m for m in args.metrics.split(",") if m)
+        if args.metrics
+        else None
+    )
+    rows, regressions = compare_summaries(
+        a, b, threshold=args.threshold, metrics=metrics
+    )
     compared = [r for r in rows if r[4] != "n/a"]
     if not compared:
         print(
@@ -853,17 +871,23 @@ def cmd_eval(args: argparse.Namespace) -> int:
             )
         return BatchedMCTS(env, ext, n.model, mcts_cfg, n.support)
 
-    from .arena import greedy_mcts_policy, play as arena_play
+    from .arena import play as arena_play, play_service
+    from .serving import PolicyService
 
     net, source = restore_net(args.checkpoint, args.run_name)
     mcts = build_search(net)
     B = args.games
     rng = np.random.default_rng(args.seed)
 
-    def play(policy_fn):
-        return arena_play(env, policy_fn, B, args.max_moves, args.seed)
-
-    mcts_policy = greedy_mcts_policy(net, mcts, use_gumbel=args.gumbel)
+    def serve_play(n, m):
+        """Search policies run through the policy service's session
+        API (serving/service.py): eval traffic and served "human"
+        traffic exercise one code path — admit/dispatch/retire over
+        the compiled `serve/b<B>` search shape."""
+        service = PolicyService(
+            env, m.extractor, n, m, slots=B, use_gumbel=args.gumbel
+        )
+        return play_service(service, B, args.max_moves, args.seed)
 
     def random_policy(states, move):
         masks = np.asarray(env.valid_mask_batch(states))
@@ -871,8 +895,10 @@ def cmd_eval(args: argparse.Namespace) -> int:
         return np.where(masks.any(axis=1), logits.argmax(axis=1), 0)
 
     print(f"Evaluating {source} net: {B} games, {args.sims} sims/move...")
-    scores, lengths, done = play(mcts_policy)
-    r_scores, r_lengths, _ = play(random_policy)
+    scores, lengths, done = serve_play(net, mcts)
+    r_scores, r_lengths, _ = arena_play(
+        env, random_policy, B, args.max_moves, args.seed
+    )
     # Both policies start from the SAME reset keys, and hand draws
     # depend only on the step index (the key chain splits every step
     # regardless of action), so game i sees the same shape sequence
@@ -919,9 +945,7 @@ def cmd_eval(args: argparse.Namespace) -> int:
             args.vs_checkpoint, args.vs_run, model_cfg_b
         )
         mcts_b = build_search(net_b, model_cfg_b)
-        b_scores, _, _ = play(
-            greedy_mcts_policy(net_b, mcts_b, use_gumbel=args.gumbel)
-        )
+        b_scores, _, _ = serve_play(net_b, mcts_b)
         h2h = scores - b_scores
         report.update(
             {
@@ -1149,6 +1173,268 @@ def cmd_warm(args: argparse.Namespace) -> int:
     return 0 if (ok and any(r["status"] == "aot" for r in rows)) else 1
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Policy-serving front end (docs/SERVING.md): a continuous-batching
+    inference service over the lockstep wave search. Many concurrent
+    game sessions multiplex onto ONE compiled `serve/b<B>` search shape
+    (serving/service.py); sessions admit/retire between dispatches and
+    partial batches pad with frozen lanes, so fluctuating load never
+    recompiles.
+
+    Startup composes the training plumbing the ROADMAP names: AOT warm
+    start through the compile cache (~0.5s when `cli warm` ran first),
+    a `cli fit`-style OOM pre-flight from the serve program's AOT
+    memory analysis (exit 1 when over budget — refuse to serve rather
+    than OOM a shared chip), then a `health.json` heartbeat + stall
+    watchdog and per-request latency records into the metrics ledger
+    (`cli perf` summarizes p50/p95 per-move latency; `cli compare`
+    gates the SLO).
+
+    Traffic is the built-in simulated-session generator (`--smoke` for
+    the bounded CI variant); a network transport plugs in at
+    `PolicyService.open_session`/`request_move`/`dispatch`. With
+    `--run-name`, `--reload-every` polls the run's checkpoints and
+    hot-swaps weights between dispatches without recompiling.
+    """
+    import json as _json
+    import os as _os
+    import time as _time
+
+    from .utils.helpers import enforce_platform
+
+    enforce_platform(args.device or ("cpu" if args.smoke else "auto"))
+
+    import jax
+
+    from .utils.helpers import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache(backend=jax.default_backend())
+
+    from .config import (
+        AlphaTriangleMCTSConfig,
+        PersistenceConfig,
+        TrainConfig,
+    )
+    from .config.run_configs import load_run_configs_or_default
+    from .env.engine import TriangleEnv
+    from .features.core import get_feature_extractor
+    from .mcts import BatchedMCTS, GumbelMCTS
+    from .nn.network import NeuralNetwork
+    from .serving import (
+        PolicyService,
+        build_serve_telemetry,
+        run_simulated_load,
+    )
+    from .stats.persistence import CheckpointManager
+    from .telemetry.health import device_memory_stats
+    from .telemetry.memory import (
+        BYTES_LIMIT_ENV,
+        FIT_OVER,
+        fit_verdict,
+        fmt_bytes,
+        serve_budget_bytes,
+    )
+
+    def say(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    def persistence_for(run_name: str) -> "PersistenceConfig":
+        p = PersistenceConfig(RUN_NAME=run_name)
+        if args.root_dir:
+            p = p.model_copy(update={"ROOT_DATA_DIR": args.root_dir})
+        return p
+
+    # Board/net configs: the served run's own configs.json when
+    # available (the same resolution `cli eval` uses), flagship
+    # defaults otherwise.
+    if args.run_name:
+        cfg_dir = persistence_for(args.run_name).get_run_base_dir()
+    elif args.checkpoint:
+        cfg_dir = Path(args.checkpoint).resolve().parent.parent
+    else:
+        cfg_dir = Path("/nonexistent")
+    env_cfg, model_cfg = load_run_configs_or_default(cfg_dir)
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+
+    # Restore weights (optional — an untrained net still serves, which
+    # is what the smoke uses).
+    trainer = mgr = None
+    source = "untrained"
+    if args.checkpoint or args.run_name:
+        from .rl import Trainer
+
+        trainer = Trainer(net, TrainConfig(RUN_NAME=args.run_name or "serve"))
+        mgr = CheckpointManager(persistence_for(args.run_name or "serve"))
+        loaded = (
+            mgr.restore_path(args.checkpoint, trainer.state)
+            if args.checkpoint
+            else mgr.restore(trainer.state)
+        )
+        if loaded.train_state is None:
+            say("serve: no checkpoint found; serving the untrained net")
+        else:
+            trainer.set_state(loaded.train_state)
+            trainer.sync_to_network()
+            source = f"step {loaded.global_step}"
+
+    if args.gumbel:
+        mcts = GumbelMCTS(
+            env, extractor, net.model, mcts_cfg, net.support, exploit=True
+        )
+    else:
+        mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
+
+    serve_run = args.serve_run_name or (
+        f"serve_{args.run_name}" if args.run_name else "serve"
+    )
+    run_dir = persistence_for(serve_run).get_run_base_dir()
+    telemetry = build_serve_telemetry(
+        run_dir, serve_run, env_cfg, model_cfg
+    )
+    from .compile_cache import get_compile_cache
+
+    get_compile_cache().set_tracer(telemetry.tracer)
+    service = PolicyService(
+        env,
+        extractor,
+        net,
+        mcts,
+        slots=args.slots,
+        use_gumbel=args.gumbel,
+        telemetry=telemetry,
+        rng_seed=args.seed,
+    )
+    say(
+        f"serve: {source} net, board {env_cfg.ROWS}x{env_cfg.COLS}, "
+        f"{args.slots} slots, {args.sims} sims/move, run dir {run_dir}"
+    )
+
+    # AOT warm start: deserialize (or compile+serialize) the serve
+    # search BEFORE admitting traffic — a `cli warm`-ed cache makes
+    # this the ~0.5s path (docs/COMPILE_CACHE.md).
+    if not args.no_warm:
+        t0 = _time.time()
+        aot = service.warm()
+        say(
+            f"serve: warm {'aot' if aot else 'jit-fallback'} "
+            f"({_time.time() - t0:.1f}s)"
+        )
+
+    # OOM pre-flight (docs/OBSERVABILITY.md "Memory"): the serve
+    # program's resident arguments + dispatch transient vs the device
+    # limit — answered before a session is admitted.
+    if not args.no_preflight:
+        record = service.analyze(persist=True)
+        budget = serve_budget_bytes(record)
+        limit = None
+        override = (args.limit_gb, _os.environ.get(BYTES_LIMIT_ENV, "").strip())
+        if override[0] is not None:
+            limit = override[0] * 2**30
+        elif override[1]:
+            try:
+                limit = float(override[1])
+            except ValueError:
+                pass
+        if limit is None:
+            limits = [
+                m.get("bytes_limit")
+                for m in device_memory_stats()
+                if isinstance(m.get("bytes_limit"), (int, float))
+                and m.get("bytes_limit") > 0
+            ]
+            limit = min(limits) if limits else None
+        if budget > 0:
+            code, reason = fit_verdict(budget, limit)
+            say(f"serve: pre-flight {fmt_bytes(budget)} — {reason}")
+            if code == FIT_OVER:
+                say("serve: refusing to serve an over-budget config")
+                return 1
+        else:
+            say("serve: pre-flight skipped (no memory analysis available)")
+        telemetry.record_memory(record)
+
+    # Hot weight reload: poll the served run's checkpoints between
+    # dispatches; a new step restores + swaps variables with zero
+    # recompiles (the compiled search reads variables as an input).
+    reload_state = {"step": mgr.latest_step() if mgr else None}
+
+    def reload_hook(svc, dispatches: int) -> None:
+        if (
+            mgr is None
+            or trainer is None
+            or args.reload_every <= 0
+            or dispatches % args.reload_every
+        ):
+            return
+        latest = mgr.latest_step()
+        if latest is None or latest == reload_state["step"]:
+            return
+        loaded = mgr.restore(trainer.state)
+        if loaded.train_state is None:
+            return
+        trainer.set_state(loaded.train_state)
+        trainer.sync_to_network()
+        reload_state["step"] = latest
+        svc.reload_weights()
+        say(f"serve: hot-reloaded weights at checkpoint step {latest}")
+
+    telemetry.start()
+    waves = []
+    try:
+        deadline = (
+            None
+            if args.duration is None
+            else _time.monotonic() + args.duration
+        )
+        while True:
+            stats = run_simulated_load(
+                service,
+                total_sessions=args.sessions,
+                concurrency=args.slots,
+                max_moves=args.max_moves,
+                seed=args.seed + len(waves),
+                tick_every=args.tick_every,
+                reload_hook=reload_hook,
+                progress=say,
+            )
+            waves.append(stats)
+            if args.smoke or deadline is None:
+                break
+            if _time.monotonic() >= deadline:
+                break
+    except KeyboardInterrupt:
+        say("serve: interrupted; draining")
+    finally:
+        service.tick()
+        telemetry.close(step=service.dispatch_count)
+
+    report = {
+        "run": serve_run,
+        "source": source,
+        "slots": args.slots,
+        "sims": args.sims,
+        "waves": len(waves),
+        "sessions_served": sum(w["sessions_served"] for w in waves),
+        "moves_served": sum(w["moves_served"] for w in waves),
+        "dispatches": service.dispatch_count,
+        "weight_reloads": service.weight_reloads,
+        "ledger": str(run_dir / "metrics.jsonl"),
+        **service.serve_stats(drain=False),
+    }
+    print(_json.dumps(report))
+    # The smoke gate: sessions actually served, latency records on the
+    # ledger (`make serve-smoke` then runs cli perf/compare on top).
+    if args.smoke:
+        ok = report["sessions_served"] >= args.sessions and (
+            run_dir / "metrics.jsonl"
+        ).exists()
+        return 0 if ok else 1
+    return 0
+
+
 def cmd_fit(args: argparse.Namespace) -> int:
     """OOM pre-flight gate (docs/OBSERVABILITY.md "Memory"): compose
     the static per-device memory budget for a bench/preset scale —
@@ -1203,6 +1489,11 @@ def cmd_fit(args: argparse.Namespace) -> int:
         # megastep program — whose argument list includes the ring —
         # is analyzed here too (rl/megastep.py).
         megastep=True,
+        # --serve additionally analyzes the policy service's
+        # `serve/b<B>` search program and persists its .mem.json
+        # sidecar (serving/service.py; docs/SERVING.md).
+        serve=args.serve,
+        serve_batch=plan.serve_batch,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
     budget = report["budget"]
@@ -1556,6 +1847,14 @@ def main(argv: list[str] | None = None) -> int:
     comp.add_argument(
         "--json", action="store_true", help="Emit the report as JSON."
     )
+    comp.add_argument(
+        "--metrics",
+        default=None,
+        metavar="M1[,M2...]",
+        help="Compare only these metrics (default: the full aligned "
+        "set, telemetry/perf.py COMPARE_METRICS). serve-smoke gates "
+        "the serving SLO rows alone with this.",
+    )
 
     trace = sub.add_parser(
         "trace",
@@ -1670,6 +1969,110 @@ def main(argv: list[str] | None = None) -> int:
     fit.add_argument(
         "--json", action="store_true", help="Emit the report as JSON."
     )
+    fit.add_argument(
+        "--serve",
+        action="store_true",
+        help="Additionally AOT-analyze the policy service's "
+        "serve/b<B> search program and persist its .mem.json sidecar "
+        "(the `cli serve` pre-flight reads it; docs/SERVING.md).",
+    )
+
+    serve = sub.add_parser(
+        "serve",
+        help="Policy-serving front end: continuous-batching inference "
+        "service over the batched wave search, with AOT-warmed "
+        "startup, OOM pre-flight, heartbeat, and per-request latency "
+        "SLOs in the metrics ledger (docs/SERVING.md).",
+    )
+    serve.add_argument(
+        "--run-name",
+        default=None,
+        help="Serve this run's latest checkpoint (and its board/net "
+        "configs); with --reload-every, newer checkpoints hot-swap in.",
+    )
+    serve.add_argument("--checkpoint", default=None, metavar="PATH")
+    serve.add_argument("--root-dir", default=None)
+    serve.add_argument(
+        "--serve-run-name",
+        default=None,
+        help="Run dir for the service's own telemetry "
+        "(default: serve_<run-name> or 'serve').",
+    )
+    serve.add_argument(
+        "--slots",
+        type=int,
+        default=64,
+        metavar="B",
+        help="Concurrent session slots = the compiled serve/b<B> "
+        "search batch shape (default 64).",
+    )
+    serve.add_argument("--sims", type=int, default=64)
+    serve.add_argument(
+        "--sessions",
+        type=int,
+        default=96,
+        metavar="N",
+        help="Simulated sessions per traffic wave (the smoke serves "
+        "exactly one wave).",
+    )
+    serve.add_argument("--max-moves", type=int, default=200)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--gumbel",
+        action="store_true",
+        help="Serve exploit-mode Gumbel search instead of greedy PUCT.",
+    )
+    serve.add_argument(
+        "--smoke",
+        action="store_true",
+        help="Bounded CI mode: serve one wave of --sessions simulated "
+        "sessions with churn, assert the latency ledger landed, exit "
+        "0/1 (make serve-smoke drives this on CPU).",
+    )
+    serve.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="Serve traffic waves until this wall budget elapses "
+        "(default: one wave, or Ctrl-C).",
+    )
+    serve.add_argument(
+        "--tick-every",
+        type=int,
+        default=8,
+        metavar="DISPATCHES",
+        help="Ledger/heartbeat tick cadence in dispatches (default 8).",
+    )
+    serve.add_argument(
+        "--reload-every",
+        type=int,
+        default=32,
+        metavar="DISPATCHES",
+        help="Poll the run's checkpoints for hot weight reload every "
+        "N dispatches (0 disables; needs --run-name).",
+    )
+    serve.add_argument(
+        "--limit-gb",
+        type=float,
+        default=None,
+        metavar="GIB",
+        help="Pre-flight device byte limit override "
+        "(also: ALPHATRIANGLE_DEVICE_BYTES_LIMIT).",
+    )
+    serve.add_argument(
+        "--no-warm",
+        action="store_true",
+        help="Skip the AOT warm-start step.",
+    )
+    serve.add_argument(
+        "--no-preflight",
+        action="store_true",
+        help="Skip the OOM pre-flight gate.",
+    )
+    serve.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
 
     mem = sub.add_parser(
         "mem",
@@ -1737,6 +2140,7 @@ def main(argv: list[str] | None = None) -> int:
         "tune": cmd_tune,
         "warm": cmd_warm,
         "fit": cmd_fit,
+        "serve": cmd_serve,
         "mem": cmd_mem,
     }
     return handlers[args.command](args)

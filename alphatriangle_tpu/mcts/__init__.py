@@ -14,6 +14,7 @@ from .helpers import (
     PolicyGenerationError,
     policy_target_from_visits,
     select_action_from_visits,
+    select_root_actions,
 )
 from .search import BatchedMCTS, SearchOutput
 
@@ -24,4 +25,5 @@ __all__ = [
     "SearchOutput",
     "policy_target_from_visits",
     "select_action_from_visits",
+    "select_root_actions",
 ]

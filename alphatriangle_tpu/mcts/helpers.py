@@ -64,6 +64,24 @@ def select_action_from_visits(
     return jnp.where(any_visits, chosen, -1).astype(jnp.int32)
 
 
+def select_root_actions(
+    output, use_gumbel: bool = False
+) -> np.ndarray:
+    """Deterministic (B,) exploitation actions from one SearchOutput.
+
+    PUCT: visit-count argmax (0 for rows with no visits — finished
+    games; the engine freezes them, so the action is inert). Gumbel:
+    the search's own final-candidate selection (`selected_action`,
+    clamped past the -1 sentinel). This is THE action rule arena play,
+    `cli eval`, and the serving dispatch share — one definition so the
+    three traffic kinds cannot drift apart.
+    """
+    if use_gumbel:
+        return np.maximum(np.asarray(output.selected_action), 0)
+    counts = np.asarray(output.visit_counts)
+    return np.where(counts.sum(axis=1) > 0, counts.argmax(axis=1), 0)
+
+
 # --- host-side dict adapters (parity with the reference surface) ----------
 
 

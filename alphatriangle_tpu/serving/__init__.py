@@ -1,0 +1,27 @@
+"""Policy-serving front end (docs/SERVING.md).
+
+Continuous-batching inference over the lockstep wave search: a fixed
+slot array of concurrent game sessions (`session.SessionSlots`), a
+request queue + micro-batch dispatcher with per-request latency SLOs
+(`service.PolicyService`), and a deterministic churn load generator
+(`loadgen.run_simulated_load`). `cli serve` is the front end;
+`arena.play` / `cli eval` / `benchmarks/elo_ladder.py` are the first
+in-repo clients of the same session API.
+"""
+
+from .loadgen import run_simulated_load
+from .service import (
+    PolicyService,
+    build_serve_telemetry,
+    serve_program_name,
+)
+from .session import Session, SessionSlots
+
+__all__ = [
+    "PolicyService",
+    "Session",
+    "SessionSlots",
+    "build_serve_telemetry",
+    "run_simulated_load",
+    "serve_program_name",
+]

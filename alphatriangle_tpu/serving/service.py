@@ -1,0 +1,369 @@
+"""Continuous-batching policy service over the lockstep wave search.
+
+`PolicyService` is the inference front end the ROADMAP's
+millions-of-users scenario needs: many concurrent game sessions
+(humans playing the current net, arena/eval traffic, a league)
+multiplexed onto ONE compiled `BatchedMCTS.search` dispatch shape.
+Requests queue between dispatches; each dispatch serves every pending
+session in one device program over the full slot array (idle/free
+lanes ride along as frozen padding — see serving/session.py for the
+lane-isolation argument), the Podracer acting-path pattern
+(arXiv:2104.06272) applied to serving.
+
+Composition of existing training plumbing, per the ROADMAP item:
+
+- **AOT warm start** — the search program is wrapped in the compile
+  cache as `serve/b<B>` (`cli warm` precompiles it alongside the bench
+  plan; a warmed `cli serve` starts answering in ~0.5 s instead of
+  after a flagship-scale search compile).
+- **OOM pre-flight** — `analyze()` AOT-analyzes the serve program's
+  HBM footprint without executing it (`estimate_fit(serve=True)`,
+  `cli fit --serve`), and persists the `.mem.json` sidecar.
+- **Latency SLOs** — per-request queue-wait and move latency land in
+  the run's metrics ledger every tick (`serve_*` fields on the
+  `kind: "util"` records), so `cli perf` summarizes p50/p95 per-move
+  latency and `cli compare` gates regressions.
+- **Liveness** — `cli serve` runs a `health.json` heartbeat + stall
+  watchdog through the same `RunTelemetry` facade training uses.
+- **Hot weight reload** — `reload_weights` swaps `net.variables`
+  between dispatches; the compiled search reads variables as an input,
+  so a reload never recompiles (the property `greedy_mcts_policy`
+  established and test_serving counter-pins).
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..mcts.helpers import select_root_actions
+from .session import SessionSlots
+
+logger = logging.getLogger(__name__)
+
+
+def serve_program_name(slots: int) -> str:
+    """The compile-cache name of the serve search program for one slot
+    shape — `serve/b<B>`, the spelling `cli warm` reports."""
+    return f"serve/b{int(slots)}"
+
+
+def _pct(values: list, q: float) -> "float | None":
+    vals = sorted(v for v in values if isinstance(v, (int, float)))
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+    return float(vals[idx])
+
+
+class PolicyService:
+    """Request queue + micro-batcher over one `SessionSlots` array.
+
+    Single-dispatcher model: any thread may open/close sessions and
+    enqueue move requests (lock-guarded, O(1)); one caller drives
+    `dispatch()` in a loop. Admission beyond the slot count raises —
+    back-pressure belongs to the caller (the load generator queues,
+    an HTTP front end would 503)."""
+
+    def __init__(
+        self,
+        env,
+        extractor,
+        net,
+        mcts,
+        slots: int,
+        use_gumbel: bool = False,
+        telemetry=None,
+        rng_seed: int = 0,
+        pad_seed: int = 0,
+        clock=time.monotonic,
+    ):
+        import jax
+
+        from ..compile_cache import config_digest, get_compile_cache
+
+        self.env = env
+        self.extractor = extractor
+        self.net = net
+        self.mcts = mcts
+        self.use_gumbel = bool(use_gumbel)
+        self.telemetry = telemetry
+        self._clock = clock
+        self.sessions = SessionSlots(env, slots, pad_seed=pad_seed)
+        # The serve program: the search jit wrapped for AOT executable
+        # caching. The digest covers everything that shapes the program
+        # but is invisible in its avals (sim budget, net architecture,
+        # board, and the search-class/exploit mode, which swap _search
+        # bodies entirely).
+        extra = config_digest(
+            mcts.config, extractor.model_config, env.cfg
+        ) + (
+            f"|{type(mcts).__name__}"
+            f"|exploit{int(getattr(mcts, 'exploit', False))}"
+        )
+        self._search = get_compile_cache().wrap(
+            serve_program_name(slots), mcts.search, extra=extra
+        )
+        self._base_rng = jax.random.PRNGKey(rng_seed)
+        self._lock = threading.RLock()
+        self._queue: deque[int] = deque()  # sids with a pending request
+        # Cumulative counters (UtilizationMeter folds deltas).
+        self.dispatch_count = 0
+        self.requests_total = 0
+        self.episodes_done_total = 0
+        self.simulations_total = 0
+        self.weight_reloads = 0
+        # Per-tick windows (drained by tick()).
+        self._win_wait_ms: list[float] = []
+        self._win_lat_ms: list[float] = []
+        self._win_batch_ms: list[float] = []
+        self._win_fill: list[float] = []
+        self._win_requests = 0
+        self._last_tick_t = clock()
+
+    # --- warm start / pre-flight --------------------------------------
+
+    def _sample_args(self):
+        import jax
+
+        return (
+            self.net.variables,
+            self.sessions.states,
+            jax.random.PRNGKey(0),
+        )
+
+    def warm(self) -> bool:
+        """AOT-ready the serve program for this slot shape (deserialize
+        or compile+serialize, never execute) — `cli warm`'s serve row
+        and `cli serve`'s startup both come through here."""
+        return self._search.warm(*self._sample_args())
+
+    def analyze(self, persist: bool = False) -> "dict | None":
+        """Memory record for the serve program (AOT analysis, never
+        executed; telemetry/memory.py). `persist=True` writes the
+        `.mem.json` sidecar beside the executable artifact."""
+        return self._search.analyze(*self._sample_args(), persist=persist)
+
+    # --- session lifecycle --------------------------------------------
+
+    def open_session(self, reset_key=None, seed: "int | None" = None):
+        """Admit one session (fresh game). Returns the Session handle.
+        Raises RuntimeError when every slot is occupied."""
+        import jax
+
+        if reset_key is None:
+            reset_key = jax.random.PRNGKey(0 if seed is None else seed)
+        with self._lock:
+            return self.sessions.admit(reset_key)
+
+    def open_sessions(self, reset_keys) -> list:
+        with self._lock:
+            return self.sessions.admit_many(reset_keys)
+
+    def close_session(self, sid: int) -> dict:
+        with self._lock:
+            s = self.sessions.session(sid)
+            s.pending_since = None
+            summary = self.sessions.retire(sid)
+            if sid in self._queue:
+                self._queue.remove(sid)
+            return summary
+
+    def request_move(self, sid: int) -> None:
+        """Enqueue one move request; a session holds at most one
+        outstanding request (it is a lockstep game, not a stream)."""
+        with self._lock:
+            s = self.sessions.session(sid)
+            if s.pending_since is not None:
+                raise RuntimeError(f"session {sid} already has a pending move")
+            s.pending_since = self._clock()
+            self._queue.append(sid)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    # --- weights --------------------------------------------------------
+
+    def reload_weights(self, variables=None) -> int:
+        """Hot-swap the served net between dispatches (no recompile:
+        variables are a program input). `variables=None` records a
+        reload performed externally — `Trainer.sync_to_network()`
+        already installs a donation-safe copy into the net, and the
+        service reads `net.variables` live. Returns the reload count."""
+        with self._lock:
+            if variables is not None:
+                self.net.set_weights(variables)
+            self.weight_reloads += 1
+            return self.weight_reloads
+
+    # --- the micro-batch dispatch ---------------------------------------
+
+    def dispatch(self, rng=None) -> list[dict]:
+        """Serve every pending request in ONE batched search + step.
+
+        Returns one result dict per served request: action, reward,
+        done, score, queue_wait_ms, latency_ms. Empty list when the
+        queue is empty (callers idle-wait)."""
+        import jax
+
+        with self._lock:
+            if not self._queue:
+                return []
+            served: list = []
+            mask = np.zeros(self.sessions.slots, dtype=bool)
+            while self._queue:
+                s = self.sessions.session(self._queue.popleft())
+                mask[s.slot] = True
+                served.append(s)
+            t0 = self._clock()
+            if rng is None:
+                rng = jax.random.fold_in(self._base_rng, self.dispatch_count)
+            out = self._search(
+                self.net.variables, self.sessions.states, rng
+            )
+            actions = select_root_actions(out, self.use_gumbel)
+            rewards, dones = self.sessions.step(actions, mask)
+            # Response materialization: the host sync IS the product
+            # here (clients need their move), one fetch per dispatch.
+            rewards_np = np.asarray(rewards)
+            dones_np = np.asarray(dones)
+            scores_np = np.asarray(self.sessions.states.score)
+            t1 = self._clock()
+
+            batch_ms = (t1 - t0) * 1e3
+            results = []
+            for s in served:
+                wait_ms = (t0 - s.pending_since) * 1e3
+                lat_ms = (t1 - s.pending_since) * 1e3
+                s.pending_since = None
+                done = bool(dones_np[s.slot])
+                if done and not s.done:
+                    s.done = True
+                    self.episodes_done_total += 1
+                s.score = float(scores_np[s.slot])
+                results.append(
+                    {
+                        "sid": s.sid,
+                        "slot": s.slot,
+                        "move": s.moves,
+                        "action": int(actions[s.slot]),
+                        "reward": float(rewards_np[s.slot]),
+                        "done": done,
+                        "score": s.score,
+                        "queue_wait_ms": wait_ms,
+                        "latency_ms": lat_ms,
+                    }
+                )
+                self._win_wait_ms.append(wait_ms)
+                self._win_lat_ms.append(lat_ms)
+            self.dispatch_count += 1
+            self.requests_total += len(results)
+            # Device work is the FULL slot array per wave regardless of
+            # fill — honest sims accounting for MFU.
+            self.simulations_total += (
+                self.sessions.slots * self.mcts.config.max_simulations
+            )
+            self._win_requests += len(results)
+            self._win_batch_ms.append(batch_ms)
+            self._win_fill.append(len(results) / self.sessions.slots)
+            if self.telemetry is not None:
+                self.telemetry.on_rollout(
+                    experiences=len(results),
+                    episodes=sum(1 for r in results if r["done"]),
+                )
+            return results
+
+    # --- SLO accounting ---------------------------------------------------
+
+    def serve_stats(self, drain: bool = True) -> dict:
+        """The `serve_*` fields for one utilization tick: current
+        occupancy + this window's request percentiles. `drain` resets
+        the window (the tick cadence)."""
+        now = self._clock()
+        dt = max(1e-9, now - self._last_tick_t)
+        snap = self.sessions.snapshot()
+        stats = {
+            "serve_slots": snap["slots"],
+            "serve_sessions": snap["live"],
+            "serve_sessions_admitted": snap["admitted_total"],
+            "serve_sessions_retired": snap["retired_total"],
+            "serve_queue_depth": self.queue_depth,
+            "serve_requests_total": self.requests_total,
+            "serve_requests_per_sec": round(self._win_requests / dt, 2),
+            "serve_batch_fill": (
+                round(float(np.mean(self._win_fill)), 4)
+                if self._win_fill
+                else None
+            ),
+            "serve_batch_ms_p50": _pct(self._win_batch_ms, 0.50),
+            "serve_batch_ms_p95": _pct(self._win_batch_ms, 0.95),
+            "serve_queue_wait_ms_p50": _pct(self._win_wait_ms, 0.50),
+            "serve_queue_wait_ms_p95": _pct(self._win_wait_ms, 0.95),
+            "serve_move_latency_ms_p50": _pct(self._win_lat_ms, 0.50),
+            "serve_move_latency_ms_p95": _pct(self._win_lat_ms, 0.95),
+            "serve_weight_reloads": self.weight_reloads,
+        }
+        if drain:
+            self._win_wait_ms = []
+            self._win_lat_ms = []
+            self._win_batch_ms = []
+            self._win_fill = []
+            self._win_requests = 0
+            self._last_tick_t = now
+        return stats
+
+    def tick(self) -> "dict | None":
+        """One telemetry tick: derive + ledger a utilization record
+        carrying the serve SLO fields, update the heartbeat. Returns
+        the record (None on the baseline tick or without telemetry)."""
+        if self.telemetry is None:
+            return None
+        stats = self.serve_stats(drain=True)
+        record = self.telemetry.on_util_tick(
+            step=self.dispatch_count,
+            episodes=self.episodes_done_total,
+            experiences=self.requests_total,
+            simulations=self.simulations_total,
+            buffer_size=self.queue_depth,
+            extra={k: v for k, v in stats.items() if v is not None},
+        )
+        self.telemetry.on_tick(
+            self.dispatch_count, buffer_size=self.queue_depth
+        )
+        return record
+
+
+def build_serve_telemetry(
+    run_dir,
+    run_name: str,
+    env_config,
+    model_config,
+    telemetry_config=None,
+):
+    """A RunTelemetry for a serve run: same heartbeat/watchdog/ledger
+    stack as training, with a meter whose FLOPs model is the serve
+    path's (network forwards only — there is no learner here)."""
+    import jax
+
+    from ..telemetry import RunTelemetry
+    from ..telemetry.perf import UtilizationMeter
+    from ..utils.flops import forward_flops
+
+    device = jax.devices()[0]
+    meter = UtilizationMeter(
+        forward_flops=forward_flops(
+            model_config, env_config, env_config.action_dim
+        ),
+        train_step_flops=0,
+        device_kind=str(getattr(device, "device_kind", device.platform)),
+        buffer_capacity=0,
+    )
+    return RunTelemetry(
+        telemetry_config,
+        run_dir=run_dir,
+        run_name=run_name,
+        perf=meter,
+    )

@@ -1,0 +1,259 @@
+"""Game-session slot array: the state tier under the policy service.
+
+The serving design problem: `BatchedMCTS.search` (mcts/search.py) is
+ONE compiled program over a fixed batch shape `(B, ...)`, but serving
+traffic is many independent games starting and ending at uncorrelated
+times (the Podracer acting/learning split, arXiv:2104.06272; RLAX-style
+many-actors-one-policy streaming, PAPERS.md). `SessionSlots` bridges
+the two: a fixed array of B device-resident game slots, sessions
+admitted into free slots and retired out of them BETWEEN dispatches, so
+one compiled search shape serves fluctuating load.
+
+Key properties the serving tests pin:
+
+- **Lane isolation.** Every per-lane quantity in the search (priors,
+  Dirichlet/Gumbel noise, descents, backups) depends only on the lane's
+  own state, its lane index, and the dispatch key — never on what other
+  lanes hold. A session pinned to slot `i` therefore plays the exact
+  same game whether the other B-1 slots hold live sessions, retired
+  leftovers, or padding. Churn cannot leak between sessions.
+- **Frozen padding.** Free slots hold `done=True` states: the engine
+  steps them as no-ops and the search evaluates them as terminal
+  (value 0), so padded lanes cost compute but never produce state.
+- **Lockstep clients stay exact.** Admitting G sessions into slots
+  0..G-1 of a G-slot array reproduces `env.reset_batch` bit for bit,
+  and a full-mask step equals `env.step_batch` — which is why
+  `arena.play` (and through it `cli eval` / `benchmarks/elo_ladder.py`)
+  runs on this API with unchanged paired-hands results.
+
+Everything here is host-side orchestration around jitted env programs;
+the per-dispatch device work is one fused scatter/step/select program
+(`_admit_rows`, `_masked_step`), not per-session Python.
+"""
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+
+import numpy as np
+
+
+def _slot_programs(env):
+    """The jitted slot-array programs for one env, built once per env
+    instance and shared by every SessionSlots over it (a per-instance
+    jit would recompile the step/scatter programs for every arena play
+    or service construction; jit's own cache handles distinct batch
+    shapes)."""
+    progs = getattr(env, "_session_slot_programs", None)
+    if progs is not None:
+        return progs
+    import jax
+    import jax.numpy as jnp
+
+    def bcast(mask, leaf):
+        return mask.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+    def admit_rows(states, fresh, idx):
+        return jax.tree_util.tree_map(
+            lambda buf, rows: buf.at[idx].set(rows), states, fresh
+        )
+
+    def masked_step(states, actions, mask):
+        stepped, rewards, dones = jax.vmap(env.step)(states, actions)
+        selected = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(bcast(mask, new), new, old),
+            stepped,
+            states,
+        )
+        return selected, rewards, dones
+
+    def freeze_slot(states, slot):
+        return states.replace(done=states.done.at[slot].set(True))
+
+    progs = SimpleNamespace(
+        admit_rows=jax.jit(admit_rows),
+        masked_step=jax.jit(masked_step),
+        freeze_slot=jax.jit(freeze_slot),
+    )
+    env._session_slot_programs = progs
+    return progs
+
+
+@dataclass
+class Session:
+    """Host bookkeeping for one live (or just-retired) game session."""
+
+    sid: int
+    slot: int
+    admitted_at: float
+    moves: int = 0
+    done: bool = False
+    score: float = 0.0
+    pending_since: "float | None" = None  # enqueue time of the open request
+    meta: dict = field(default_factory=dict)
+
+    def summary(self) -> dict:
+        return {
+            "sid": self.sid,
+            "slot": self.slot,
+            "moves": self.moves,
+            "score": self.score,
+            "done": self.done,
+        }
+
+
+class SessionSlots:
+    """Fixed-shape slot array of concurrent game sessions.
+
+    `slots` is the compiled batch shape: every search/step dispatch is
+    over all `slots` lanes regardless of how many are occupied. Slots
+    are assigned lowest-free-first, so a deterministic admit order
+    yields deterministic lane placement (what makes serving results
+    reproducible and the arena client's pairing exact).
+    """
+
+    def __init__(self, env, slots: int, pad_seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.env = env
+        self.slots = int(slots)
+        self._jnp = jnp
+        self._free: list[int] = list(range(self.slots))
+        self._by_slot: dict[int, Session] = {}
+        self._sessions: dict[int, Session] = {}
+        self._sid_counter = itertools.count(1)
+        self.admitted_total = 0
+        self.retired_total = 0
+
+        # Padding base: reset states frozen with done=True (inert for
+        # both the engine and the search).
+        keys = jax.random.split(jax.random.PRNGKey(pad_seed), self.slots)
+        base = env.reset_batch(keys)
+        self.states = base.replace(
+            done=jnp.ones((self.slots,), dtype=base.done.dtype)
+        )
+        progs = _slot_programs(env)
+        self._admit_rows = progs.admit_rows
+        self._masked_step = progs.masked_step
+        self._freeze_slot = progs.freeze_slot
+
+    # --- occupancy ----------------------------------------------------
+
+    @property
+    def live_count(self) -> int:
+        return len(self._sessions)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def live_sessions(self) -> list[Session]:
+        return list(self._sessions.values())
+
+    def session(self, sid: int) -> Session:
+        return self._sessions[sid]
+
+    def live_mask(self) -> np.ndarray:
+        mask = np.zeros(self.slots, dtype=bool)
+        for s in self._sessions.values():
+            mask[s.slot] = True
+        return mask
+
+    # --- admit / retire (between dispatches only) ---------------------
+
+    def admit_many(self, reset_keys) -> list[Session]:
+        """Admit len(reset_keys) sessions into the lowest free slots
+        (ONE row-scatter dispatch). Raises when the array is full —
+        back-pressure is the caller's queue, not silent eviction."""
+        import jax.numpy as jnp
+
+        keys = jnp.asarray(reset_keys)
+        n = int(keys.shape[0])
+        if n == 0:
+            return []
+        if n > len(self._free):
+            raise RuntimeError(
+                f"admit_many({n}): only {len(self._free)} of "
+                f"{self.slots} slots free"
+            )
+        self._free.sort()
+        taken, self._free = self._free[:n], self._free[n:]
+        fresh = self.env.reset_batch(keys)
+        self.states = self._admit_rows(
+            self.states, fresh, jnp.asarray(taken, dtype=jnp.int32)
+        )
+        now = time.monotonic()
+        out = []
+        for slot in taken:
+            s = Session(
+                sid=next(self._sid_counter), slot=slot, admitted_at=now
+            )
+            self._sessions[s.sid] = s
+            self._by_slot[slot] = s
+            out.append(s)
+        self.admitted_total += n
+        return out
+
+    def admit(self, reset_key) -> Session:
+        return self.admit_many(reset_key[None])[0]
+
+    def retire(self, sid: int) -> dict:
+        """Release a session's slot (re-frozen so the lane stays inert)
+        and return its final summary. Reads the slot's score/step_count
+        from the device — retirement is a host sync by definition."""
+        s = self._sessions.pop(sid)
+        self._by_slot.pop(s.slot, None)
+        s.score = float(np.asarray(self.states.score[s.slot]))
+        s.moves = int(np.asarray(self.states.step_count[s.slot]))
+        s.done = bool(np.asarray(self.states.done[s.slot]))
+        self.states = self._freeze_slot(self.states, s.slot)
+        self._free.append(s.slot)
+        self.retired_total += 1
+        return s.summary()
+
+    # --- the lockstep step --------------------------------------------
+
+    def step(self, actions, mask):
+        """Step lanes where `mask` is True; the rest keep their state
+        bit for bit. Returns device (rewards, dones) for ALL lanes
+        (callers sync only what they need). `actions`/`mask` are (B,)
+        host or device arrays."""
+        import jax.numpy as jnp
+
+        mask_np = np.asarray(mask, dtype=bool)
+        actions = jnp.asarray(actions, dtype=jnp.int32)
+        self.states, rewards, dones = self._masked_step(
+            self.states, actions, jnp.asarray(mask_np)
+        )
+        # Advisory move counter (retire() reads the authoritative
+        # step_count from the device).
+        for s in self._sessions.values():
+            if mask_np[s.slot]:
+                s.moves += 1
+        return rewards, dones
+
+    # --- host views ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Occupancy facts for heartbeats/ticks (no device sync)."""
+        return {
+            "slots": self.slots,
+            "live": self.live_count,
+            "free": self.free_count,
+            "admitted_total": self.admitted_total,
+            "retired_total": self.retired_total,
+        }
+
+    def host_results(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(scores, step_counts, done) for the whole slot array as
+        NumPy — ONE host sync; the arena client calls this once at the
+        end of a run instead of per move."""
+        return (
+            np.asarray(self.states.score),
+            np.asarray(self.states.step_count),
+            np.asarray(self.states.done),
+        )

@@ -183,6 +183,16 @@ class HealthMonitor:
             "mem_peak_bytes_in_use",
             "mem_bytes_limit",
             "mem_utilization",
+            # Policy-service SLO fields (serving/service.py): the serve
+            # heartbeat answers "alive AND inside latency budget?".
+            "serve_sessions",
+            "serve_queue_depth",
+            "serve_requests_per_sec",
+            "serve_move_latency_ms_p50",
+            "serve_move_latency_ms_p95",
+            "serve_queue_wait_ms_p95",
+            "serve_batch_fill",
+            "serve_weight_reloads",
         )
         trimmed = {k: record.get(k) for k in keep if k in record}
         with self._lock:

@@ -215,6 +215,15 @@ _PROM_HELP = {
     "mem_bytes_limit": "Device memory limit across local devices, bytes",
     "mem_utilization": "Device memory in use / limit",
     "step": "Learner global step",
+    # Policy-service SLO gauges (serving/service.py serve ticks).
+    "serve_sessions": "Live serving sessions occupying slots",
+    "serve_queue_depth": "Move requests waiting for the next dispatch",
+    "serve_requests_per_sec": "Served move requests per second",
+    "serve_move_latency_ms_p50": "Per-move serve latency p50 this window, ms",
+    "serve_move_latency_ms_p95": "Per-move serve latency p95 this window, ms",
+    "serve_queue_wait_ms_p95": "Queue wait p95 this window, ms",
+    "serve_batch_fill": "Real sessions per dispatch / slot count",
+    "serve_weight_reloads": "Hot weight reloads served so far",
 }
 
 

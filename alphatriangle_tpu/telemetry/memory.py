@@ -306,6 +306,27 @@ def compose_budget(records) -> dict:
     }
 
 
+def serve_budget_bytes(record) -> int:
+    """Per-device bytes a STANDALONE policy service needs, from its
+    serve program's memory record: resident arguments (net variables +
+    the slot-array states) plus the dispatch transient (the
+    program-reported whole-program peak wins when present). There is
+    no learner state and no replay ring on a serving chip — this is
+    the `cli serve` pre-flight's budget, next to `compose_budget`'s
+    training-process one."""
+    if not isinstance(record, dict):
+        return 0
+    b = record.get("bytes") or {}
+    arg = int(b.get("argument") or 0)
+    peak = record.get("peak")
+    transient = (
+        int(peak)
+        if isinstance(peak, (int, float))
+        else int(record.get("transient") or 0)
+    )
+    return arg + transient
+
+
 def fit_verdict(total_bytes, bytes_limit) -> tuple:
     """(exit code, reason) for a budget against a per-device limit."""
     if not isinstance(bytes_limit, (int, float)) or bytes_limit <= 0:
@@ -371,6 +392,8 @@ def estimate_fit(
     fused_k: int = 4,
     device_replay: bool = False,
     megastep: bool = False,
+    serve: bool = False,
+    serve_batch: "int | None" = None,
     progress=None,
 ) -> dict:
     """Build the run's hot programs AOT (lowered + compiled, never
@@ -384,7 +407,11 @@ def estimate_fit(
     additionally analyzes the fused-megastep program (rl/megastep.py) —
     this one DOES allocate the configured ring (its storage is a
     program argument), so it is opt-in; `cli fit` enables it since its
-    bench-plan capacities are small.
+    bench-plan capacities are small. `serve` additionally analyzes the
+    policy service's `serve/b<B>` search program (serving/service.py;
+    B = `serve_batch`, default the self-play lane count) and persists
+    its `.mem.json` sidecar — the OOM pre-flight `cli serve` runs
+    before occupying a chip.
     """
     from ..env.engine import TriangleEnv
     from ..features.core import get_feature_extractor
@@ -448,6 +475,35 @@ def estimate_fit(
             (
                 f"megastep/t{chunk}_k{fused_k}",
                 lambda: runner.analyze_megastep(chunk, fused_k),
+            )
+        )
+    if serve:
+        from ..serving import PolicyService, serve_program_name
+
+        slots = int(serve_batch or train_config.SELF_PLAY_BATCH_SIZE)
+        serve_gumbel = (
+            getattr(mcts_config, "root_selection", "puct") == "gumbel"
+        )
+        if serve_gumbel:
+            from ..mcts import GumbelMCTS
+
+            serve_mcts = GumbelMCTS(
+                env, extractor, net.model, mcts_config, net.support,
+                exploit=True,
+            )
+        else:
+            serve_mcts = engine.mcts
+        service = PolicyService(
+            env, extractor, net, serve_mcts, slots=slots,
+            use_gumbel=serve_gumbel,
+        )
+        targets.append(
+            (
+                serve_program_name(slots),
+                # persist=True: the serve sidecar survives into the
+                # cache dir so a later `cli serve` pre-flight reads it
+                # without re-lowering.
+                lambda: service.analyze(persist=True),
             )
         )
     for label, fn in targets:

@@ -40,7 +40,11 @@ SUMMARY_SCHEMA = "alphatriangle.perf.v1"
 # when they DROP; the memory metrics (peak bytes per device run-wide,
 # composed static budget) regress when they GROW — a run that suddenly
 # needs more HBM is a regression against the fit headroom even when it
-# is no slower.
+# is no slower. The serve metrics (serving/service.py) are the policy
+# service's SLOs: per-move latency p95 regresses when it grows,
+# served requests/s when it drops. Rows compare only when BOTH sides
+# carry the metric, so training-vs-training comparisons never see the
+# serve rows and vice versa.
 COMPARE_METRICS = (
     "games_per_hour",
     "moves_per_sec",
@@ -48,11 +52,17 @@ COMPARE_METRICS = (
     "mfu",
     "mem_peak_bytes_in_use",
     "memory_budget_bytes",
+    "serve_move_latency_ms_p95",
+    "serve_requests_per_sec",
 )
 
 # Metrics where a LOWER candidate value is the good direction.
 LOWER_IS_BETTER = frozenset(
-    {"mem_peak_bytes_in_use", "memory_budget_bytes"}
+    {
+        "mem_peak_bytes_in_use",
+        "memory_budget_bytes",
+        "serve_move_latency_ms_p95",
+    }
 )
 
 
@@ -109,8 +119,14 @@ class UtilizationMeter:
         device_memory: "list | None" = None,
         dispatches: int = 0,
         iterations: int = 0,
+        extra: "dict | None" = None,
     ) -> "dict | None":
-        """One derived utilization record, or None (first/zero-width tick)."""
+        """One derived utilization record, or None (first/zero-width tick).
+
+        `extra`: caller-owned fields merged verbatim into the record —
+        the policy service rides its per-window `serve_*` SLO fields
+        (queue wait / move latency percentiles, occupancy) into the
+        ledger this way (serving/service.py)."""
         now = self._clock()
         # Memory accounting folds on EVERY tick (including the baseline
         # tick that yields no rate record) so the high-water mark never
@@ -148,7 +164,7 @@ class UtilizationMeter:
             else None
         )
         total_compiles = compile_hits + compile_misses
-        return {
+        record = {
             **(mem or {}),
             "kind": "util",
             "step": step,
@@ -200,6 +216,9 @@ class UtilizationMeter:
                 else None
             ),
         }
+        if extra:
+            record.update(extra)
+        return record
 
     def _fold_memory(self, device_memory: "list | None") -> "dict | None":
         """Device-memory totals for one tick (telemetry/memory.py) +
@@ -293,7 +312,43 @@ def summarize_utilization(
 
     last = records[-1]
     mfus = [v for v in col("mfu") if isinstance(v, (int, float))]
+
+    def numeric(key: str) -> list:
+        return [v for v in col(key) if isinstance(v, (int, float))]
+
+    # Serve SLO summary (records written by serving/service.py ticks):
+    # p50 averages across tick windows, p95 takes the WORST window —
+    # the conservative bound an SLO gate wants.
+    serve: dict = {}
+    if numeric("serve_move_latency_ms_p95"):
+        serve = {
+            "serve_move_latency_ms_p50": _mean(
+                numeric("serve_move_latency_ms_p50")
+            ),
+            "serve_move_latency_ms_p95": max(
+                numeric("serve_move_latency_ms_p95")
+            ),
+            "serve_queue_wait_ms_p50": _mean(
+                numeric("serve_queue_wait_ms_p50")
+            ),
+            "serve_queue_wait_ms_p95": (
+                max(numeric("serve_queue_wait_ms_p95"))
+                if numeric("serve_queue_wait_ms_p95")
+                else None
+            ),
+            "serve_requests_per_sec": _mean(
+                numeric("serve_requests_per_sec")
+            ),
+            "serve_requests_total": last.get("serve_requests_total"),
+            "serve_sessions_last": last.get("serve_sessions"),
+            "serve_sessions_admitted": last.get("serve_sessions_admitted"),
+            "serve_sessions_retired": last.get("serve_sessions_retired"),
+            "serve_slots": last.get("serve_slots"),
+            "serve_batch_fill": _mean(numeric("serve_batch_fill")),
+            "serve_weight_reloads": last.get("serve_weight_reloads"),
+        }
     return {
+        **serve,
         "schema": SUMMARY_SCHEMA,
         "ticks": len(records),
         "ticks_total": full_span,
@@ -431,20 +486,23 @@ def _run_dir_for(run_name: str, root_dir: "str | None") -> "Path | None":
 
 
 def compare_summaries(
-    a: dict, b: dict, threshold: float = 0.1
+    a: dict, b: dict, threshold: float = 0.1, metrics=None
 ) -> tuple[list, list]:
     """(rows, regressions) comparing candidate `a` against baseline `b`.
 
     A row is (metric, a_value, b_value, ratio, status). For throughput
     metrics, status is "regression" when a < b * (1 - threshold) and
     "improved" when a > b * (1 + threshold); for LOWER_IS_BETTER
-    metrics (peak bytes, memory budget) the directions flip — growth
-    past the threshold is the regression. "n/a" when either side is
-    missing. `regressions` lists the regressed metric names.
+    metrics (peak bytes, memory budget, serve latency p95) the
+    directions flip — growth past the threshold is the regression.
+    "n/a" when either side is missing. `regressions` lists the
+    regressed metric names. `metrics` restricts the compared set (the
+    `cli compare --metrics` selector; serve-smoke gates the serve SLO
+    rows alone with it); default is all of COMPARE_METRICS.
     """
     rows = []
     regressions = []
-    for metric in COMPARE_METRICS:
+    for metric in metrics if metrics is not None else COMPARE_METRICS:
         va, vb = a.get(metric), b.get(metric)
         usable = all(
             isinstance(v, (int, float)) and not isinstance(v, bool)

@@ -162,6 +162,44 @@ def warm_bench_programs(
     targets.append(
         (f"megastep/t{plan.chunk}_k{plan.fused_k}", mega_fn)
     )
+    # Policy-service search shape (serving/service.py): warming
+    # `serve/b<B>` is what turns `cli serve` startup from a flagship
+    # search compile into a ~0.5s deserialize. The search program has
+    # no donated buffers, so (unlike the learner family) its AOT
+    # artifacts are safe on every backend. The service's search kind
+    # follows the plan's root-selection recipe: Gumbel recipes serve
+    # exploit-mode Gumbel (the deterministic arm `cli eval --gumbel`
+    # and `cli serve --gumbel` dispatch), PUCT recipes serve PUCT.
+    if plan.serve_batch > 0:
+        from .serving import PolicyService
+
+        serve_gumbel = (
+            getattr(plan.mcts, "root_selection", "puct") == "gumbel"
+        )
+        if serve_gumbel:
+            from .mcts import GumbelMCTS
+
+            serve_mcts = GumbelMCTS(
+                env, extractor, net.model, plan.mcts, net.support,
+                exploit=True,
+            )
+        else:
+            from .mcts import BatchedMCTS
+
+            serve_mcts = BatchedMCTS(
+                env, extractor, net.model, plan.mcts, net.support
+            )
+        serve_service = PolicyService(
+            env,
+            extractor,
+            net,
+            serve_mcts,
+            slots=plan.serve_batch,
+            use_gumbel=serve_gumbel,
+        )
+        targets.append(
+            (f"serve/b{plan.serve_batch}", serve_service.warm)
+        )
     if programs:
         targets = [
             (name, fn)

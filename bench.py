@@ -818,6 +818,70 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         }
         log(f"bench: megastep {megastep_section}")
         extra["megastep"] = megastep_section
+        emit(snapshot("megastep"))
+
+    # --- policy-serving latency (serving/service.py) --------------------
+    # The serving front end's SLO numbers next to the training numbers:
+    # simulated concurrent sessions with admit/retire churn through the
+    # continuous batcher at the plan's `serve/b<B>` shape (the shape
+    # `cli warm` precompiles). Overall p50/p95 per-move latency,
+    # requests/s and batch fill land in extra["serve"] — the same
+    # metrics `cli perf` summarizes from a real serve run's ledger and
+    # `cli compare` gates. BENCH_SERVE=0 skips.
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        from alphatriangle_tpu.serving import (
+            PolicyService,
+            run_simulated_load,
+        )
+
+        serve_slots = plan.serve_batch
+        serve_gumbel = (
+            getattr(mcts_cfg, "root_selection", "puct") == "gumbel"
+        )
+        if serve_gumbel:
+            # Mirror `cli warm`'s construction exactly: serving
+            # dispatches the deterministic exploit-mode Gumbel arm.
+            from alphatriangle_tpu.mcts import GumbelMCTS
+
+            serve_mcts = GumbelMCTS(
+                env, extractor, net.model, mcts_cfg, net.support,
+                exploit=True,
+            )
+        else:
+            serve_mcts = engine.mcts
+        serve_service = PolicyService(
+            env, extractor, net, serve_mcts,
+            slots=serve_slots, use_gumbel=serve_gumbel,
+        )
+        log(f"bench: warming serve/b{serve_slots}...")
+        t0 = time.time()
+        serve_service.warm()
+        serve_compile_s = time.time() - t0
+        serve_stats = run_simulated_load(
+            serve_service,
+            total_sessions=serve_slots + max(8, serve_slots // 2),
+            max_moves=8 if smoke else 32,
+            seed=0,
+            max_dispatches=4000,
+        )
+        # No telemetry ticks drained the service's windows, so these
+        # percentiles cover every request of the section.
+        slo = serve_service.serve_stats(drain=False)
+        serve_section = {
+            "slots": serve_slots,
+            "sessions_served": serve_stats["sessions_served"],
+            "moves_served": serve_stats["moves_served"],
+            "seconds": serve_stats["seconds"],
+            "compile_seconds": round(serve_compile_s, 1),
+            "requests_per_sec": serve_stats["moves_per_sec"],
+            "move_latency_ms_p50": slo["serve_move_latency_ms_p50"],
+            "move_latency_ms_p95": slo["serve_move_latency_ms_p95"],
+            "queue_wait_ms_p95": slo["serve_queue_wait_ms_p95"],
+            "batch_ms_p50": slo["serve_batch_ms_p50"],
+            "batch_fill": slo["serve_batch_fill"],
+        }
+        log(f"bench: serve {serve_section}")
+        extra["serve"] = serve_section
     log(f"bench: flops/mfu {extra['flops']}")
     return snapshot(None)
 

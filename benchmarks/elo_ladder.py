@@ -56,7 +56,7 @@ def main() -> None:
 
     import numpy as np
 
-    from alphatriangle_tpu.arena import greedy_mcts_policy, play
+    from alphatriangle_tpu.arena import play_service
     from alphatriangle_tpu.config import (
         AlphaTriangleMCTSConfig,
         PersistenceConfig,
@@ -97,14 +97,20 @@ def main() -> None:
         steps = [steps[int(i)] for i in idx]
     print(f"ladder rungs (steps): {steps}")
 
-    # One net + trainer + compiled search; each rung restores its
-    # weights into the SAME NeuralNetwork (greedy_mcts_policy reads
-    # net.variables at call time), so the heavy search program
-    # compiles once for the whole ladder.
+    # One net + trainer + ONE policy service for the whole ladder:
+    # every rung is a hot weight reload into the same compiled
+    # `serve/b<games>` search (the service reads net.variables at
+    # dispatch time), so the heavy search program compiles once and
+    # ladder traffic runs the same session API served "human" traffic
+    # does (serving/service.py, docs/SERVING.md).
+    from alphatriangle_tpu.serving import PolicyService
+
     net = NeuralNetwork(model_cfg, env_cfg, seed=0)
     trainer = Trainer(net, train_cfg)
     mcts = BatchedMCTS(env, extractor, net.model, mcts_cfg, net.support)
-    policy = greedy_mcts_policy(net, mcts)
+    service = PolicyService(
+        env, extractor, net, mcts, slots=args.games
+    )
 
     # Scores are deterministic per rung given the fixed keys, so the
     # full round-robin needs one playout per rung.
@@ -116,8 +122,9 @@ def main() -> None:
         assert loaded.train_state is not None, step
         trainer.set_state(loaded.train_state)
         trainer.sync_to_network()
-        scores[step], _, _ = play(
-            env, policy, args.games, args.max_moves, args.seed
+        service.reload_weights()  # counted hot swap, zero recompiles
+        scores[step], _, _ = play_service(
+            service, args.games, args.max_moves, args.seed
         )
 
     n = len(steps)

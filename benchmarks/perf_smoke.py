@@ -259,6 +259,21 @@ def main() -> int:
             return rc
         summary = json.loads(buf.getvalue())
         summary["source"] = "benchmarks/perf_smoke.py --write-reference"
+        # The serve smoke (benchmarks/serve_smoke.py) merges its
+        # serve_* SLO rows into this same reference file; preserve
+        # them across training-side regenerations.
+        if REFERENCE.exists():
+            try:
+                old = json.loads(REFERENCE.read_text())
+                summary.update(
+                    {
+                        k: v
+                        for k, v in old.items()
+                        if k.startswith("serve_")
+                    }
+                )
+            except json.JSONDecodeError:
+                pass
         REFERENCE.write_text(json.dumps(summary, indent=2) + "\n")
         print(f"perf-smoke: reference written to {REFERENCE}")
         return 0

@@ -1,0 +1,234 @@
+"""CI serve-smoke gate: `cli serve --smoke` -> `cli perf`/`cli compare`.
+
+`make serve-smoke` runs this. It proves, on any machine with no
+accelerator, that the policy-serving front end (docs/SERVING.md) works
+end to end:
+
+1. a run dir with the test-sized world's configs.json is staged, and
+   `cli serve --smoke` serves >= 64 concurrent simulated sessions
+   through batched search dispatches — sessions admitted AND retired
+   mid-run (total sessions > slot count forces churn), AOT warm start
+   and the OOM pre-flight on the way up;
+2. the serve run's `metrics.jsonl` must carry `kind: "util"` records
+   with per-request latency SLO fields (`serve_move_latency_ms_p50/
+   p95`, `serve_queue_wait_ms_*`, `serve_requests_per_sec`);
+3. `cli perf <serve_run> --json` must summarize them (exit 2 = the
+   ledger schema broke);
+4. `cli compare <serve_run> benchmarks/perf_reference_cpu_smoke.json
+   --metrics serve_move_latency_ms_p95,serve_requests_per_sec` gates
+   the serve SLO rows against the checked-in reference. The threshold
+   is deliberately generous (default 3.0: fail only on a 4x latency
+   blowup) because CI hosts vary wildly in speed — the hard signal is
+   schema alignment plus "not catastrophically slower".
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise. `--write-reference` merges this run's `serve_*` summary
+fields into perf_reference_cpu_smoke.json (preserving the training
+smoke's fields — the two smokes share one reference file).
+"""
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REFERENCE = Path(__file__).resolve().parent / "perf_reference_cpu_smoke.json"
+RUN_NAME = "serve_smoke"
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+# Must precede any jax import: the smoke must not wake (or wedge on) an
+# accelerator.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
+
+SERVE_METRICS = "serve_move_latency_ms_p95,serve_requests_per_sec"
+SLOTS = 64  # >= 64 concurrent sessions (the acceptance bar)
+SESSIONS = 96  # > SLOTS forces admit/retire churn mid-run
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=3.0,
+        help="compare tolerance vs the checked-in serve reference "
+        "(generous by design: CI hosts vary in speed).",
+    )
+    parser.add_argument(
+        "--root-dir",
+        default=None,
+        help="Runs root for the smoke (default: a temp dir).",
+    )
+    parser.add_argument(
+        "--write-reference",
+        action="store_true",
+        help=f"Merge this run's serve_* summary into {REFERENCE.name}.",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from alphatriangle_tpu.cli import main as cli_main
+    from alphatriangle_tpu.config import PersistenceConfig
+
+    # The training perf smoke's tiny world — one definition, reused.
+    from perf_smoke import tiny_configs  # noqa: E402
+
+    root = args.root_dir or tempfile.mkdtemp(prefix="at_serve_smoke_")
+    env_cfg, model_cfg, _mcts_cfg, _train_cfg = tiny_configs()
+
+    # Stage a run dir whose configs.json pins the tiny world, so
+    # `cli serve --run-name` serves it instead of the flagship net.
+    src_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=RUN_NAME)
+    src_dir = src_pc.get_run_base_dir()
+    src_dir.mkdir(parents=True, exist_ok=True)
+    (src_dir / "configs.json").write_text(
+        json.dumps(
+            {"env": env_cfg.model_dump(), "model": model_cfg.model_dump()}
+        )
+    )
+
+    print(
+        f"serve-smoke: serving {SESSIONS} sessions over {SLOTS} slots "
+        f"under {root}...",
+        flush=True,
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(
+            [
+                "serve",
+                "--smoke",
+                "--run-name", RUN_NAME,
+                "--root-dir", root,
+                "--slots", str(SLOTS),
+                "--sessions", str(SESSIONS),
+                "--sims", "4",
+                "--max-moves", "40",
+                "--tick-every", "4",
+                "--seed", "0",
+            ]
+        )
+    sys.stdout.write(buf.getvalue())
+    if rc != 0:
+        print(f"serve-smoke: cli serve failed (rc={rc})", file=sys.stderr)
+        return rc
+    report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    if report["sessions_served"] < SESSIONS:
+        print(
+            f"serve-smoke: only {report['sessions_served']} of "
+            f"{SESSIONS} sessions served",
+            file=sys.stderr,
+        )
+        return 1
+    # Churn proof: more sessions than slots can only complete by
+    # retiring finished sessions and admitting replacements mid-run.
+    if report["sessions_served"] <= SLOTS:
+        print("serve-smoke: no churn exercised", file=sys.stderr)
+        return 1
+
+    serve_run = f"serve_{RUN_NAME}"
+    serve_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=serve_run)
+    ledger = serve_pc.get_run_base_dir() / "metrics.jsonl"
+    lat_records = []
+    for line in ledger.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "util" and isinstance(
+            rec.get("serve_move_latency_ms_p95"), (int, float)
+        ):
+            lat_records.append(rec)
+    if not lat_records:
+        print(
+            f"serve-smoke: {ledger} holds no util record with serve "
+            "latency fields — the SLO ledger broke",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"serve-smoke: {len(lat_records)} ledger record(s) with "
+        "per-request latency fields"
+    )
+
+    print("serve-smoke: cli perf --json (schema gate)...", flush=True)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["perf", serve_run, "--root-dir", root, "--json"])
+    if rc != 0:
+        print(f"serve-smoke: cli perf failed (rc={rc})", file=sys.stderr)
+        return rc
+    summary = json.loads(buf.getvalue())
+    for key in (
+        "serve_move_latency_ms_p50",
+        "serve_move_latency_ms_p95",
+        "serve_requests_per_sec",
+    ):
+        if not isinstance(summary.get(key), (int, float)):
+            print(
+                f"serve-smoke: cli perf --json lacks {key}",
+                file=sys.stderr,
+            )
+            return 2
+    print(
+        "serve-smoke: move latency p50 "
+        f"{summary['serve_move_latency_ms_p50']:.1f}ms, p95 "
+        f"{summary['serve_move_latency_ms_p95']:.1f}ms, "
+        f"{summary['serve_requests_per_sec']:.0f} req/s"
+    )
+
+    if args.write_reference:
+        reference = (
+            json.loads(REFERENCE.read_text()) if REFERENCE.exists() else {}
+        )
+        reference.update(
+            {
+                k: v
+                for k, v in summary.items()
+                if k.startswith("serve_")
+            }
+        )
+        reference.setdefault("schema", summary["schema"])
+        REFERENCE.write_text(json.dumps(reference, indent=2) + "\n")
+        print(f"serve-smoke: serve rows merged into {REFERENCE}")
+        return 0
+
+    print(
+        f"serve-smoke: cli compare vs {REFERENCE.name} "
+        f"(serve SLO rows, threshold {args.threshold:.0%})...",
+        flush=True,
+    )
+    rc = cli_main(
+        [
+            "compare",
+            serve_run,
+            str(REFERENCE),
+            "--root-dir", root,
+            "--threshold", str(args.threshold),
+            "--metrics", SERVE_METRICS,
+        ]
+    )
+    if rc != 0:
+        print(f"serve-smoke: cli compare failed (rc={rc})", file=sys.stderr)
+        return rc
+    if args.root_dir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    print("serve-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,10 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # BEFORE spending the window on the real command — on a warm cache
     # this is seconds; cold, it front-loads the ~minute-per-program
     # compiles so the sweep's sections start measuring immediately.
+    # `warm auto` covers the policy-serving shape too (`serve/b<B>`,
+    # reported alongside megastep/t·_k· in the warm summary), so a
+    # `cli serve` brought up in the same window starts answering in
+    # ~0.5s instead of burning it on a search compile (docs/SERVING.md).
     # Best-effort: a warm failure (or a wedge mid-warm) must not stop
     # the sweep attempt.
     if [ "$warm_s" -gt 0 ]; then

@@ -69,6 +69,46 @@ class TestArenaPlay:
         scores, _, _ = play(env, policy, games=4, max_moves=5, seed=1)
         assert scores.shape == (4,)
 
+    def test_termination_check_interval_preserves_paired_hands(
+        self, arena_world
+    ):
+        """The every-8-moves termination check (vs the old per-move
+        `states.done` host sync) is a pure dispatch-count optimization:
+        stepping all-done lanes is a frozen no-op, so scores/lengths/
+        done are bit-identical at any check interval (fixed seed)."""
+        env, _, net, mcts, _ = arena_world
+        policy = greedy_mcts_policy(net, mcts)
+        every_move = play(
+            env, policy, games=4, max_moves=12, seed=5,
+            termination_check_every=1,
+        )
+        deferred = play(
+            env, policy, games=4, max_moves=12, seed=5,
+            termination_check_every=8,
+        )
+        for a, b in zip(every_move, deferred):
+            np.testing.assert_array_equal(a, b)
+
+    def test_play_service_matches_direct_play(self, arena_world):
+        """Arena traffic through the policy service's queue/dispatch
+        path (the `cli eval` / elo_ladder route) reproduces direct
+        greedy-MCTS arena play exactly — the acceptance bar for
+        serving and eval sharing one code path."""
+        from alphatriangle_tpu.arena import play_service
+        from alphatriangle_tpu.serving import PolicyService
+
+        env, fe, net, mcts, _ = arena_world
+        direct = play(
+            env, greedy_mcts_policy(net, mcts), games=4, max_moves=10,
+            seed=3,
+        )
+        service = PolicyService(env, fe, net, mcts, slots=4)
+        served = play_service(service, games=4, max_moves=10, seed=3)
+        for a, b in zip(direct, served):
+            np.testing.assert_array_equal(a, b)
+        assert service.sessions.live_count == 0  # all retired
+        assert service.sessions.retired_total == 4
+
 
 class TestRunConfigs:
     def test_roundtrip(self, tmp_path, tiny_env_config, tiny_model_config):

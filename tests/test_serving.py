@@ -1,0 +1,409 @@
+"""Policy-serving subsystem (alphatriangle_tpu/serving/): session
+slot-array semantics, continuous-batching dispatch, SLO ledger wiring,
+hot weight reload, and the `cli serve --smoke` front end."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.env.engine import TriangleEnv
+from alphatriangle_tpu.features.core import get_feature_extractor
+from alphatriangle_tpu.mcts import BatchedMCTS
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.serving import (
+    PolicyService,
+    SessionSlots,
+    build_serve_telemetry,
+    run_simulated_load,
+    serve_program_name,
+)
+
+SLOTS = 8
+
+
+@pytest.fixture(scope="module")
+def serve_world(tiny_env_config, tiny_model_config):
+    from alphatriangle_tpu.config import AlphaTriangleMCTSConfig
+
+    env = TriangleEnv(tiny_env_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+    # A deliberately small search (4 sims, depth 3): these tests pin
+    # queue/slot/swap semantics, not search quality — and ONE search
+    # instance module-wide means every PolicyService shares the jitted
+    # program (and the serve/b8 executable).
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=4, max_depth=3, mcts_batch_size=4
+    )
+    mcts = BatchedMCTS(env, fe, net.model, mcts_cfg, net.support)
+    return env, fe, net, mcts
+
+
+def make_service(serve_world, **kw):
+    env, fe, net, mcts = serve_world
+    return PolicyService(env, fe, net, mcts, slots=SLOTS, **kw)
+
+
+class TestSessionSlots:
+    def test_admit_retire_churn_reuses_lowest_slots(self, serve_world):
+        env = serve_world[0]
+        slots = SessionSlots(env, 4)
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        a, b, c, d = slots.admit_many(keys)
+        assert [s.slot for s in (a, b, c, d)] == [0, 1, 2, 3]
+        with pytest.raises(RuntimeError):
+            slots.admit(jax.random.PRNGKey(9))
+        slots.retire(b.sid)
+        slots.retire(d.sid)
+        # Freed lanes re-freeze (inert padding for search and engine).
+        done = np.asarray(slots.states.done)
+        assert done[1] and done[3]
+        e = slots.admit(jax.random.PRNGKey(5))
+        assert e.slot == 1  # lowest free slot first
+        assert slots.admitted_total == 5 and slots.retired_total == 2
+        assert slots.live_count == 3 and slots.free_count == 1
+
+    def test_masked_step_leaves_unmasked_lanes_untouched(self, serve_world):
+        env = serve_world[0]
+        slots = SessionSlots(env, 4)
+        slots.admit_many(jax.random.split(jax.random.PRNGKey(1), 4))
+        before = jax.tree_util.tree_map(np.asarray, slots.states)
+        # Step only lanes 0 and 2 with a valid action each.
+        masks = np.asarray(env.valid_mask_batch(slots.states))
+        actions = masks.argmax(axis=1)
+        mask = np.array([True, False, True, False])
+        slots.step(actions, mask)
+        after = jax.tree_util.tree_map(np.asarray, slots.states)
+        np.testing.assert_array_equal(
+            before.step_count[[1, 3]], after.step_count[[1, 3]]
+        )
+        np.testing.assert_array_equal(
+            before.occupied[[1, 3]], after.occupied[[1, 3]]
+        )
+        assert (after.step_count[[0, 2]] == before.step_count[[0, 2]] + 1).all()
+
+
+def drive_session(service, reset_key, dispatch_keys, churn=False, seed=7):
+    """Admit ONE tracked session (slot 0) and drive it to completion
+    with a fixed dispatch-key sequence; optionally churn other
+    sessions around it. Returns the tracked session's (actions,
+    scores) trajectory."""
+    tracked = service.open_session(reset_key)
+    assert tracked.slot == 0
+    others = []
+    if churn:
+        others = service.open_sessions(
+            jax.random.split(jax.random.PRNGKey(seed), 3)
+        )
+        for o in others:
+            service.request_move(o.sid)
+    actions, scores = [], []
+    for i, key in enumerate(dispatch_keys):
+        service.request_move(tracked.sid)
+        results = service.dispatch(rng=key)
+        mine = next(r for r in results if r["sid"] == tracked.sid)
+        actions.append(mine["action"])
+        scores.append(mine["score"])
+        if churn:
+            # Real churn: retire + replace neighbours mid-stream.
+            for r in results:
+                if r["sid"] == tracked.sid:
+                    continue
+                if r["done"] or i % 2:
+                    service.close_session(r["sid"])
+                else:
+                    service.request_move(r["sid"])
+            n_fresh = min(2, service.sessions.free_count)
+            if n_fresh:
+                fresh = service.open_sessions(
+                    jax.random.split(
+                        jax.random.PRNGKey(1000 + seed + i), n_fresh
+                    )
+                )
+                for o in fresh:
+                    service.request_move(o.sid)
+        if mine["done"]:
+            break
+    service.close_session(tracked.sid)
+    for s in list(service.sessions.live_sessions()):
+        service.close_session(s.sid)
+    return actions, scores
+
+
+class TestPolicyService:
+    def test_padded_slots_never_leak_into_real_sessions(self, serve_world):
+        """Lane isolation: a session pinned to slot 0 plays the exact
+        same game whether the other lanes are empty padding or a
+        churning crowd of admits/retires — the property that makes
+        partial-batch padding correct."""
+        reset_key = jax.random.PRNGKey(42)
+        dispatch_keys = [jax.random.PRNGKey(100 + i) for i in range(10)]
+        solo = drive_session(
+            make_service(serve_world), reset_key, dispatch_keys,
+            churn=False,
+        )
+        crowded = drive_session(
+            make_service(serve_world), reset_key, dispatch_keys,
+            churn=True,
+        )
+        assert solo == crowded
+
+    def test_dispatch_serves_queue_and_reports_latency(self, serve_world):
+        service = make_service(serve_world)
+        sessions = service.open_sessions(
+            jax.random.split(jax.random.PRNGKey(3), 5)
+        )
+        for s in sessions:
+            service.request_move(s.sid)
+        assert service.queue_depth == 5
+        results = service.dispatch()
+        assert service.queue_depth == 0
+        assert {r["sid"] for r in results} == {s.sid for s in sessions}
+        for r in results:
+            assert r["latency_ms"] >= r["queue_wait_ms"] >= 0.0
+        assert service.dispatch_count == 1
+        assert service.requests_total == 5
+        stats = service.serve_stats(drain=False)
+        assert stats["serve_move_latency_ms_p95"] is not None
+        assert stats["serve_batch_fill"] == pytest.approx(5 / SLOTS)
+        for s in sessions:
+            service.close_session(s.sid)
+
+    def test_double_request_rejected(self, serve_world):
+        service = make_service(serve_world)
+        s = service.open_session(jax.random.PRNGKey(0))
+        service.request_move(s.sid)
+        with pytest.raises(RuntimeError):
+            service.request_move(s.sid)
+        service.dispatch()
+        service.close_session(s.sid)
+
+    def test_hot_weight_swap_mid_stream(self, serve_world):
+        """reload_weights between dispatches changes play without a
+        recompile: the swapped run diverges from the unswapped one
+        after the swap point, and the compile cache records no new
+        event for the serve program."""
+        from alphatriangle_tpu.compile_cache import get_compile_cache
+
+        env, fe, net, mcts = serve_world
+        reset_key = jax.random.PRNGKey(11)
+        dispatch_keys = [jax.random.PRNGKey(500 + i) for i in range(8)]
+        original = net.get_weights()
+        try:
+            baseline = drive_session(
+                make_service(serve_world), reset_key, dispatch_keys
+            )
+
+            service = make_service(serve_world)
+            tracked = service.open_session(reset_key)
+            # Load the executable first (a fresh service instance
+            # deserializes once — a legitimate cache event); THEN pin
+            # that the weight swap itself causes no compile activity.
+            service.warm()
+            events_before = len(get_compile_cache().stats()["events"])
+            actions = []
+            for i, key in enumerate(dispatch_keys):
+                if i == 2:
+                    perturbed = jax.tree_util.tree_map(
+                        lambda x: x + 0.5, net.variables
+                    )
+                    assert service.reload_weights(perturbed) == 1
+                service.request_move(tracked.sid)
+                results = service.dispatch(rng=key)
+                mine = next(
+                    r for r in results if r["sid"] == tracked.sid
+                )
+                actions.append(mine["action"])
+                if mine["done"]:
+                    break
+            assert actions[:2] == baseline[0][:2]
+            assert actions != baseline[0]  # the swap changed play
+            assert (
+                len(get_compile_cache().stats()["events"])
+                == events_before
+            )
+            service.close_session(tracked.sid)
+        finally:
+            net.set_weights(original)  # module-scoped fixture
+
+    def test_loadgen_churn_and_ledger_records(
+        self, serve_world, tiny_env_config, tiny_model_config, tmp_path
+    ):
+        """>slots sessions through the batcher with telemetry: churn
+        completes, and the run dir gains util records carrying the
+        serve latency fields plus a heartbeat with the serve view."""
+        env, fe, net, mcts = serve_world
+        telemetry = build_serve_telemetry(
+            tmp_path, "serve_test", tiny_env_config, tiny_model_config
+        )
+        service = PolicyService(
+            env, fe, net, mcts, slots=SLOTS, telemetry=telemetry
+        )
+        total = SLOTS + 5  # > slots: churn by construction
+        stats = run_simulated_load(
+            service,
+            total_sessions=total,
+            max_moves=15,
+            seed=1,
+            tick_every=2,
+            max_dispatches=200,
+        )
+        telemetry.close(step=service.dispatch_count)
+        assert stats["sessions_served"] == total
+        assert service.sessions.retired_total == total
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+        ]
+        lat = [
+            r
+            for r in records
+            if r.get("kind") == "util"
+            and isinstance(
+                r.get("serve_move_latency_ms_p95"), (int, float)
+            )
+        ]
+        assert lat, "no util record carried serve latency fields"
+        assert lat[-1]["serve_sessions_retired"] == total
+        health = json.loads((tmp_path / "health.json").read_text())
+        # The heartbeat carries the serve view. (The latency
+        # percentiles ride only on ticks whose window served requests
+        # — the final drain tick may be empty — so assert on the
+        # always-present occupancy/rate fields.)
+        assert "serve_requests_per_sec" in (
+            health.get("utilization") or {}
+        )
+        assert "serve_queue_depth" in (health.get("utilization") or {})
+
+    def test_warm_and_analyze(self, serve_world):
+        """The serve program AOT-warms and yields a memory record
+        named serve/b<B> (the `cli warm` / `cli fit --serve` rows)."""
+        service = make_service(serve_world)
+        assert service.warm() is True
+        record = service.analyze()
+        assert record is not None
+        assert record["program"] == serve_program_name(SLOTS)
+        from alphatriangle_tpu.telemetry.memory import serve_budget_bytes
+
+        assert serve_budget_bytes(record) > 0
+
+
+class TestServeSummary:
+    def test_perf_summary_carries_serve_fields(self):
+        from alphatriangle_tpu.telemetry.perf import summarize_utilization
+
+        records = [
+            {
+                "kind": "util",
+                "step": i,
+                "window_s": 1.0,
+                "serve_move_latency_ms_p50": 5.0 + i,
+                "serve_move_latency_ms_p95": 9.0 + i,
+                "serve_queue_wait_ms_p50": 1.0,
+                "serve_queue_wait_ms_p95": 2.0,
+                "serve_requests_per_sec": 100.0,
+                "serve_requests_total": 100 * (i + 1),
+                "serve_sessions": 4,
+                "serve_batch_fill": 0.5,
+                "serve_weight_reloads": i,
+            }
+            for i in range(3)
+        ]
+        summary = summarize_utilization(records)
+        assert summary["serve_move_latency_ms_p95"] == 11.0  # worst window
+        assert summary["serve_move_latency_ms_p50"] == 6.0  # mean
+        assert summary["serve_requests_total"] == 300
+        assert summary["serve_weight_reloads"] == 2
+
+    def test_compare_gates_serve_latency_lower_is_better(self):
+        from alphatriangle_tpu.telemetry.perf import compare_summaries
+
+        base = {
+            "serve_move_latency_ms_p95": 10.0,
+            "serve_requests_per_sec": 100.0,
+        }
+        slow = {
+            "serve_move_latency_ms_p95": 25.0,
+            "serve_requests_per_sec": 100.0,
+        }
+        rows, regressions = compare_summaries(slow, base, threshold=0.5)
+        assert regressions == ["serve_move_latency_ms_p95"]
+        fast = {"serve_move_latency_ms_p95": 4.0}
+        rows, regressions = compare_summaries(
+            fast, base, threshold=0.5,
+            metrics=("serve_move_latency_ms_p95",),
+        )
+        assert not regressions
+        assert rows[0][4] == "improved"
+        # --metrics restricts the compared set.
+        assert len(rows) == 1
+
+
+class TestServeCli:
+    @pytest.mark.slow
+    def test_cli_serve_smoke_exit_0(
+        self, tmp_path, tiny_env_config, tiny_model_config, capsys
+    ):
+        """`cli serve --smoke` end to end on the tiny world: warm +
+        pre-flight + churn traffic + SLO ledger, exit 0.
+
+        Marked slow (the megastep precedent): it compiles its own
+        serve search program, and `make serve-smoke` runs the bigger
+        sibling of this exact path in CI; tier-1 keeps the in-process
+        service tests above."""
+        from alphatriangle_tpu.cli import main as cli_main
+        from alphatriangle_tpu.config import PersistenceConfig
+
+        root = str(tmp_path)
+        pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME="tiny_src")
+        run_dir = pc.get_run_base_dir()
+        run_dir.mkdir(parents=True)
+        (run_dir / "configs.json").write_text(
+            json.dumps(
+                {
+                    "env": tiny_env_config.model_dump(),
+                    "model": tiny_model_config.model_dump(),
+                }
+            )
+        )
+        rc = cli_main(
+            [
+                "serve",
+                "--smoke",
+                "--run-name", "tiny_src",
+                "--root-dir", root,
+                "--slots", "8",
+                "--sessions", "12",
+                "--sims", "4",
+                "--max-moves", "20",
+                "--tick-every", "3",
+            ]
+        )
+        assert rc == 0
+        report = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert report["sessions_served"] >= 12
+        serve_dir = PersistenceConfig(
+            ROOT_DATA_DIR=root, RUN_NAME="serve_tiny_src"
+        ).get_run_base_dir()
+        assert (serve_dir / "metrics.jsonl").exists()
+        assert (serve_dir / "health.json").exists()
+        # And `cli perf --json` summarizes the SLO fields (the full
+        # compare gate lives in `make serve-smoke`).
+        rc = cli_main(
+            ["perf", "serve_tiny_src", "--root-dir", root, "--json"]
+        )
+        assert rc == 0
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert isinstance(
+            summary.get("serve_move_latency_ms_p95"), (int, float)
+        )
+        assert isinstance(
+            summary.get("serve_requests_per_sec"), (int, float)
+        )
