@@ -79,7 +79,8 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
         action="store_true",
         help="Anakin-style fused megastep: rollout chunk + ring ingest "
         "+ on-device PER sampling + K learner steps as ONE device "
-        "program per iteration (single-device; needs the device ring — "
+        "program per iteration; dp-shards over a multi-device mesh "
+        "when capacity/batch/lanes divide dp (needs the device ring — "
         "rl/megastep.py, docs/PARALLELISM.md).",
     )
     p.add_argument(

@@ -40,9 +40,47 @@ critically — buffer persistence, so checkpoints and resume are
 interchangeable with the other loop modes. `sync_priorities_from_host`
 (re)seeds the device array from the mirror after restores/warmup.
 
-Scope: single-process, single-device mesh (the same gate as
-`DeviceReplayBuffer`). The dp-sharded megastep — per-device rings +
-`shard_map` sampling — is future work (docs/PARALLELISM.md).
+dp-sharded megastep (multi-device meshes):
+
+On a single-process dp-only mesh the SAME fused program spans every
+device (program family `megastep/dp<D>_t<T>_k<K>`), composing the three
+sharded seams the codebase already has:
+
+- the rollout chunk runs lane-sharded under GSPMD (each device plays
+  its B/dp games — lanes are independent, so no collectives appear);
+- ONE `shard_map` region (parallel/sharding.py::shard_map_compat) does
+  the per-shard replay work with no collectives except a weight-norm
+  `pmax`: every shard ring-scatters ITS lanes' rows into ITS ring shard
+  (`ShardedDeviceReplayBuffer.scatter_local`, cap_local slots + a trash
+  row), max-priority-inits them in its slice of the dp-sharded priority
+  array, samples its B/dp stratum of each of the K batches from that
+  device-local slice (`sample_local`, per-shard rng via
+  `fold_in(key, axis_index)`), IS-normalizes against the global batch
+  max (`pmax` over dp), and gathers its sampled rows locally — indices
+  come back globally encoded as `shard * stride + slot`;
+- the K learner steps run on the dp-sharded stacked batch under GSPMD
+  with replicated params: the gradient `psum` over dp is inserted by
+  XLA from the shardings (the repo-wide idiom — rl/trainer.py spells no
+  collective by hand), so params stay bit-identical on every shard;
+- a second small `shard_map` writes the K steps' TD-error priorities
+  back into each shard's priority slice, in step order.
+
+Host reconciliation generalizes per shard: the program returns (dp,)
+per-shard counts + globally-encoded (K, B) sampled indices + TD errors,
+and the host replays them into the per-shard SumTree mirrors
+(`ShardedDeviceReplayBuffer.reconcile_ingest` at the SAME pre-dispatch
+max-priority watermark the device sampled against, then
+`update_priorities` routed by the global index encoding). Checkpoints
+keep flowing through the buffer's snapshot contract, so resume is
+interchangeable with sync/overlapped/single-device-megastep runs.
+
+Scope: single-process; single-device mesh, or a dp-only mesh whose
+capacity/batch/lanes divide dp (the `ShardedDeviceReplayBuffer` gate in
+training/setup.py). Sharded sampling draws per-shard strata with
+per-shard keys, so sampled BATCHES differ from a single-device run at
+the same seed — the pinned invariants are params bit-identical across
+shards and device/host priority agreement per shard
+(tests/test_megastep_sharded.py).
 
 CPU note: the program contains learner steps, so it rides
 `cpu_aot=False` like the rest of the learner family (an XLA:CPU
@@ -79,38 +117,68 @@ class MegastepRunner:
         buffer: DeviceReplayBuffer,
         train_config: TrainConfig,
     ):
-        if not getattr(buffer, "is_device", False) or getattr(
-            buffer, "is_sharded", False
-        ):
+        if not getattr(buffer, "is_device", False):
             raise ValueError(
-                "MegastepRunner needs the single-device replay ring "
-                "(rl/device_buffer.DeviceReplayBuffer); the dp-sharded "
-                "megastep is not implemented yet."
-            )
-        if engine.mesh is not None:
-            raise ValueError(
-                "MegastepRunner is single-device: the self-play engine "
-                "must not be mesh-sharded (megastep over a dp mesh is "
-                "future work)."
+                "MegastepRunner needs a device-resident replay ring "
+                "(rl/device_buffer.DeviceReplayBuffer, or the dp-sharded "
+                "rl/sharded_device_buffer.ShardedDeviceReplayBuffer)."
             )
         if jax.process_count() > 1:
             raise ValueError("MegastepRunner is single-process only.")
+        self.sharded = bool(getattr(buffer, "is_sharded", False))
+        if self.sharded:
+            # The fused program's shard_map region pairs each device's
+            # rollout lanes with its own ring shard: the engine must
+            # shard its lanes over exactly the ring's mesh + dp axis.
+            if engine.mesh is None or engine.mesh != buffer.mesh:
+                raise ValueError(
+                    "Sharded megastep: the self-play engine must shard "
+                    "its lanes over the replay ring's mesh (got engine "
+                    f"mesh {engine.mesh}, ring mesh {buffer.mesh})."
+                )
+            if tuple(engine.data_axes) != (buffer.dp_axis,):
+                raise ValueError(
+                    "Sharded megastep: engine lanes must ride exactly "
+                    f"the ring's dp axis ({buffer.dp_axis!r}); got "
+                    f"{tuple(engine.data_axes)}."
+                )
+            if trainer.mesh != buffer.mesh:
+                raise ValueError(
+                    "Sharded megastep: trainer and replay ring must "
+                    "share one mesh."
+                )
+            if train_config.BATCH_SIZE % buffer.dp != 0:
+                raise ValueError(
+                    f"BATCH_SIZE={train_config.BATCH_SIZE} must divide "
+                    f"over dp={buffer.dp} (each shard samples its B/dp "
+                    "stratum in-program)."
+                )
+        elif engine.mesh is not None:
+            raise ValueError(
+                "MegastepRunner with the single-device ring needs a "
+                "single-device engine; mesh-sharded lanes pair with the "
+                "dp-sharded ring (ShardedDeviceReplayBuffer)."
+            )
         self.engine = engine
         self.trainer = trainer
         self.buffer = buffer
         self.config = train_config
         self.batch_size = train_config.BATCH_SIZE
         self.cap = buffer.capacity
+        self.dp = buffer.dp if self.sharded else 1
         self.use_per = train_config.USE_PER
         self.per_alpha = float(train_config.PER_ALPHA)
         self.per_epsilon = float(train_config.PER_EPSILON)
         self.beta_initial = float(train_config.PER_BETA_INITIAL)
         self.beta_final = float(train_config.PER_BETA_FINAL)
         self.beta_anneal = float(train_config.PER_BETA_ANNEAL_STEPS or 1)
-        # Device-resident priority array, (cap + 1,) float32 — the +1 is
-        # the trash slot, pinned at priority 0 so it is never sampled.
-        # None until `sync_priorities_from_host` seeds it (lazily on the
-        # first megastep, or explicitly after a checkpoint restore).
+        # Device-resident priority array — the sampling truth inside
+        # the program. Single-device: (cap + 1,) float32, the +1 the
+        # trash slot pinned at priority 0 so it is never sampled.
+        # Sharded: (dp * stride,) float32 sharded over dp, one trash
+        # slot per shard at local index cap_local. None until
+        # `sync_priorities_from_host` seeds it (lazily on the first
+        # megastep, or explicitly after a checkpoint restore).
         self._priorities: jax.Array | None = None
         # One compiled program per distinct (chunk moves, K) pair, AOT
         # cached. cpu_aot=False: the program donates + updates the train
@@ -124,11 +192,17 @@ class MegastepRunner:
         ) + (
             f"|att{int(getattr(trainer.nn.model, 'attention_fn', None) is not None)}"
         )
+        impl = self._sharded_impl if self.sharded else self._impl
+        name = (
+            (lambda t, k: f"megastep/dp{self.dp}_t{t}_k{k}")
+            if self.sharded
+            else (lambda t, k: f"megastep/t{t}_k{k}")
+        )
         self._megastep_fn = functools.lru_cache(maxsize=None)(
             lambda t, k: get_compile_cache().wrap(
-                f"megastep/t{t}_k{k}",
+                name(t, k),
                 jax.jit(
-                    functools.partial(self._impl, t, k),
+                    functools.partial(impl, t, k),
                     donate_argnums=(0, 1, 2, 3),
                 ),
                 extra=extra,
@@ -270,15 +344,214 @@ class MegastepRunner:
         }
         return new_state, new_carry, new_storage, priorities, out
 
+    def _sharded_impl(
+        self,
+        num_moves: int,
+        k: int,
+        state,
+        carry,
+        storage,
+        priorities,
+        cursors,
+        sizes,
+        max_priority,
+    ):
+        """The dp-sharded fused megastep (pure; donated: state, carry,
+        storage, priorities). Same five phases as `_impl`, with the
+        replay phases per shard under ONE shard_map region and the
+        rollout/learner phases under GSPMD — the learner's gradient
+        psum over dp comes from the shardings (replicated params,
+        dp-sharded batch), not from hand-written collectives, so params
+        stay bit-identical on every shard.
+
+        `cursors`/`sizes` are (dp,) int32 per-shard ring state (from
+        the host mirror, like `_impl`'s scalar cursor/size); indices
+        return globally encoded (`shard * stride + slot`)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharding import shard_map_compat
+
+        buf = self.buffer
+        dp_axis = buf.dp_axis
+        b_local = self.batch_size // buf.dp
+
+        # 1. Rollout chunk with the learner's live params, lane-sharded
+        # over dp under GSPMD (the engine's own mesh-mode program body).
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        new_carry, outs = self.engine._chunk(
+            num_moves, variables, carry, state.step.astype(jnp.int32)
+        )
+        mat, flush = outs.pop("mat"), outs.pop("flush")
+
+        # Per-call scalars for the shard_map region, computed OUTSIDE
+        # it: one sampling key split off the train state (each shard
+        # folds in its axis index for an independent stratum draw) and
+        # beta on the learner-step clock, exactly as `_sample_indices`.
+        rng, k_sample = jax.random.split(state.rng)
+        state = state.replace(rng=rng)
+        if self.use_per:
+            frac = jnp.clip(
+                state.step.astype(jnp.float32) / self.beta_anneal, 0.0, 1.0
+            )
+            beta = self.beta_initial + frac * (
+                self.beta_final - self.beta_initial
+            )
+        else:
+            beta = jnp.float32(0.0)
+
+        def shard_body(
+            storage_local,
+            priorities_local,
+            cursor_local,
+            size_local,
+            mat_local,
+            flush_local,
+            max_p,
+            key,
+            beta_,
+        ):
+            # 2+3. Scatter this shard's lanes into this shard's ring
+            # slice, fresh rows max-priority-inited, trash row pinned.
+            new_storage, new_prios, count = buf.scatter_local(
+                storage_local,
+                priorities_local if self.use_per else None,
+                cursor_local[0],
+                (mat_local, flush_local),
+                max_p,
+            )
+            if new_prios is None:
+                new_prios = priorities_local
+            new_size = jnp.minimum(size_local[0] + count, buf.cap_local)
+            # 4. Sample this shard's B/dp stratum of each of the K
+            # batches from the device-local priority slice.
+            shard = jax.lax.axis_index(dp_axis)
+            idx_local, w = buf.sample_local(
+                new_prios,
+                new_size,
+                k,
+                b_local,
+                jax.random.fold_in(key, shard),
+                beta_,
+            )
+            if self.use_per:
+                # One max-normalization across the GLOBAL batch per
+                # step row (the host path's single batch-wide
+                # normalization) — the region's only collective.
+                wmax = jax.lax.pmax(
+                    jnp.max(w, axis=1, keepdims=True), dp_axis
+                )
+                w = w / wmax
+            w = w.astype(jnp.float32)
+            # Local row gather: each device reads only its own shard.
+            rows = {name: v[idx_local] for name, v in new_storage.items()}
+            idx_global = (shard * buf.stride + idx_local).astype(jnp.int32)
+            return (
+                new_storage,
+                new_prios,
+                count.reshape(1),
+                idx_global,
+                w,
+                rows,
+            )
+
+        shd, stk, rep = P(dp_axis), P(None, dp_axis), P()
+        (
+            new_storage,
+            priorities,
+            counts,
+            idx,
+            weights,
+            rows,
+        ) = shard_map_compat(
+            shard_body,
+            mesh=buf.mesh,
+            in_specs=(shd, shd, shd, shd, stk, stk, rep, rep, rep),
+            out_specs=(shd, shd, shd, stk, stk, stk),
+        )(storage, priorities, cursors, sizes, mat, flush,
+          max_priority, k_sample, beta)
+
+        # 5. K fused learner steps on the (K, B) stacked batch, dp-
+        # sharded on axis 1 (the shard_map's out_specs): GSPMD inserts
+        # the gradient all-reduce over dp, params remain replicated.
+        stacked = {
+            "grid": rows["grid"].astype(jnp.float32),
+            "other_features": rows["other_features"],
+            "policy_target": rows["policy_target"],
+            "value_target": rows["value_target"],
+            "policy_weight": rows["policy_weight"],
+            "weights": weights,
+        }
+        new_state, metrics_k, td_k = self.trainer._train_steps_impl(
+            state, stacked
+        )
+
+        # 6. TD-error priority write-back, per shard in step order
+        # (each shard owns exactly the indices it sampled — the global
+        # encoding routes by arithmetic, no cross-shard traffic).
+        if self.use_per:
+            stride = buf.stride
+
+            def write_prios(priorities_local, idx_local, td_local):
+                base = jax.lax.axis_index(dp_axis) * stride
+                p = priorities_local
+                for j in range(k):
+                    prio_j = (
+                        jnp.abs(td_local[j]) + self.per_epsilon
+                    ) ** self.per_alpha
+                    p = p.at[idx_local[j] - base].set(
+                        prio_j.astype(jnp.float32)
+                    )
+                return p
+
+            priorities = shard_map_compat(
+                write_prios,
+                mesh=buf.mesh,
+                in_specs=(shd, stk, stk),
+                out_specs=shd,
+            )(priorities, idx, td_k)
+
+        out = {
+            "counts": counts,  # (dp,) per-shard rows written
+            "episode": outs["episode"],
+            "trace": outs["trace"],
+            "sentinel_live": outs["sentinel_live"],
+            "metrics": metrics_k,
+            "td": td_k,
+            "idx": idx,
+        }
+        return new_state, new_carry, new_storage, priorities, out
+
     # --- host API ---------------------------------------------------------
+
+    def _max_priority_watermark(self) -> float:
+        """The pre-dispatch max-priority watermark fresh rows enter at
+        — the host mirror reconciliation reuses the SAME value."""
+        if self.sharded:
+            return self.buffer.max_priority
+        tree = self.buffer.tree
+        return float(tree.max_priority) if tree is not None else 1.0
 
     def sync_priorities_from_host(self) -> None:
         """(Re)seed the device priority array from the host SumTree
-        mirror — after warmup ingests, a checkpoint restore, or any
+        mirror(s) — after warmup ingests, a checkpoint restore, or any
         other host-side write. Device becomes the sampling truth from
         the next megastep on."""
+        buf = self.buffer
+        if self.sharded:
+            # (dp * stride,) laid out shard-major, matching the global
+            # encoding; per-shard trash rows stay 0.
+            p = np.zeros(buf.dp * buf.stride, np.float32)
+            if buf.trees is not None:
+                for s, tree in enumerate(buf.trees):
+                    leaves = np.arange(buf.cap_local) + tree._cap2
+                    lo = s * buf.stride
+                    p[lo : lo + buf.cap_local] = tree.tree[leaves]
+            self._priorities = jnp.asarray(p)
+            return
         p = np.zeros(self.cap + 1, np.float32)
-        tree = self.buffer.tree
+        tree = buf.tree
         if tree is not None:
             leaves = np.arange(self.cap) + tree._cap2
             p[: self.cap] = tree.tree[leaves]
@@ -288,8 +561,39 @@ class MegastepRunner:
         if self._priorities is None:
             self.sync_priorities_from_host()
         buf = self.buffer
-        tree = buf.tree
-        max_p = float(tree.max_priority) if tree is not None else 1.0
+        max_p = self._max_priority_watermark()
+        if self.sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            args = (
+                self.trainer.state,
+                self.engine._carry,
+                buf.storage,
+                self._priorities,
+                jnp.asarray(buf._cursors, jnp.int32),
+                jnp.asarray(buf._sizes, jnp.int32),
+                jnp.float32(max_p),
+            )
+            shard = NamedSharding(buf.mesh, P(buf.dp_axis))
+            rep = NamedSharding(buf.mesh, P())
+            # Commit every argument AT ITS PROGRAM SHARDING before
+            # dispatch — the same recompile trap as the single-device
+            # path below, with shardings instead of a device: the first
+            # call's host-built arrays (seeded priorities, cursors, the
+            # scalars) would otherwise key a second compile once the
+            # previous megastep's committed outputs flow back in.
+            return jax.device_put(
+                args,
+                (
+                    self.trainer._state_shard,
+                    self.engine._carry_shardings(),
+                    shard,
+                    shard,
+                    shard,
+                    shard,
+                    rep,
+                ),
+            )
         args = (
             self.trainer.state,
             self.engine._carry,
@@ -324,8 +628,7 @@ class MegastepRunner:
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         k = int(k or max(1, self.config.FUSED_LEARNER_STEPS))
         buf, engine, trainer = self.buffer, self.engine, self.trainer
-        tree = buf.tree
-        max_p = float(tree.max_priority) if tree is not None else 1.0
+        max_p = self._max_priority_watermark()
         args = self._dispatch_args(t, k)
         start_step = trainer._host_step
         (
@@ -341,28 +644,46 @@ class MegastepRunner:
         self.transfer_d2h_seconds += time.perf_counter() - t0
 
         # --- host mirror reconciliation (megastep boundary) ----------
-        count = int(host["rows_added"])
-        # One chunk's rows (B * (T + n) worst case) must fit the ring
-        # for the mirror's slot arithmetic to stay 1:1 with surviving
-        # rows — same assumption as the sharded ring's ingest assert.
-        assert count <= self.cap, (
-            f"megastep ingested {count} rows into a {self.cap}-slot "
-            "ring in one scatter (shrink ROLLOUT_CHUNK_MOVES or grow "
-            "BUFFER_CAPACITY)"
-        )
-        slots = (buf._pos + np.arange(count)) % self.cap
-        if tree is not None and count:
-            # Fresh rows at the same pre-group watermark the device used.
-            tree.update_batch(slots, np.full(count, max_p))
-            tree.data_pointer = int((buf._pos + count) % self.cap)
-            tree.n_entries = min(buf._size + count, self.cap)
-        buf._pos = int((buf._pos + count) % self.cap)
-        buf._size = min(buf._size + count, self.cap)
-        # TD-error priority updates, in the same step order the device
-        # applied them.
-        if tree is not None:
-            for j in range(k):
-                buf.update_priorities(host["idx"][j], host["td"][j])
+        if self.sharded:
+            counts = np.asarray(host["counts"]).reshape(-1)
+            count = int(counts.sum())
+            # Per-shard SumTree mirrors, cursors and sizes replay the
+            # device's scatter at the SAME pre-dispatch watermark it
+            # sampled against; then the TD updates route by the global
+            # index encoding, in the device's step order.
+            buf.reconcile_ingest(
+                counts,
+                max_priority=max_p if buf.trees is not None else None,
+            )
+            if buf.trees is not None:
+                for j in range(k):
+                    buf.update_priorities(host["idx"][j], host["td"][j])
+        else:
+            tree = buf.tree
+            count = int(host["rows_added"])
+            # One chunk's rows (B * (T + n) worst case) must fit the
+            # ring for the mirror's slot arithmetic to stay 1:1 with
+            # surviving rows — same assumption as the sharded ring's
+            # ingest assert.
+            assert count <= self.cap, (
+                f"megastep ingested {count} rows into a {self.cap}-slot "
+                "ring in one scatter (shrink ROLLOUT_CHUNK_MOVES or grow "
+                "BUFFER_CAPACITY)"
+            )
+            slots = (buf._pos + np.arange(count)) % self.cap
+            if tree is not None and count:
+                # Fresh rows at the same pre-group watermark the device
+                # used.
+                tree.update_batch(slots, np.full(count, max_p))
+                tree.data_pointer = int((buf._pos + count) % self.cap)
+                tree.n_entries = min(buf._size + count, self.cap)
+            buf._pos = int((buf._pos + count) % self.cap)
+            buf._size = min(buf._size + count, self.cap)
+            # TD-error priority updates, in the same step order the
+            # device applied them.
+            if tree is not None:
+                for j in range(k):
+                    buf.update_priorities(host["idx"][j], host["td"][j])
 
         # --- engine-side stats (play_chunk's host tail) --------------
         engine.last_trace = host["trace"]

@@ -151,6 +151,29 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         )
         self.dispatch_count += 1
         counts = np.asarray(counts_dev)  # (dp,) — the one fetch
+        return self.reconcile_ingest(counts)
+
+    def reconcile_ingest(
+        self,
+        counts: np.ndarray,
+        max_priority: "float | None" = None,
+    ) -> tuple[int, np.ndarray]:
+        """Host bookkeeping for rows a device program ALREADY scattered
+        into the shards (per-shard write order: cursor, cursor+1, ...):
+        SumTree max-priority init, per-shard cursors/sizes, the global
+        size. Callers are the dispatching ingest above and the sharded
+        megastep (rl/megastep.py), which scatters INSIDE its fused
+        program and reconciles from the returned per-shard counts.
+
+        `max_priority` pins the watermark fresh rows enter at — the
+        sharded megastep passes the single pre-dispatch watermark its
+        device program sampled against, so mirror and device priorities
+        stay row-for-row equal; None uses each tree's own current
+        watermark (the plain ingest path's per-shard semantics).
+
+        Returns (total rows written, their globally-encoded slots in
+        per-shard write order)."""
+        counts = np.asarray(counts).reshape(-1)
         # Host-side slot reconstruction below assumes each shard wrote
         # at most cap_local rows this ingest (slot uniqueness): a count
         # above cap_local would mean the ring lapped itself WITHIN one
@@ -174,9 +197,14 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
             all_slots.append(k * self.stride + local)
             if self.trees is not None:
                 tree = self.trees[k]
+                watermark = (
+                    tree.max_priority
+                    if max_priority is None
+                    else max_priority
+                )
                 tree.update_batch(
                     local,
-                    np.full(c, tree.max_priority, dtype=np.float64),
+                    np.full(c, watermark, dtype=np.float64),
                 )
                 tree.data_pointer = int(
                     (self._cursors[k] + c) % self.cap_local
@@ -199,6 +227,99 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         into the sharded ring. Each device's lanes scatter into its own
         shard; only the per-shard counts come back."""
         return self._ingest_blocks((payload["mat"], payload["flush"]))[0]
+
+    # --- in-program entry points (the sharded megastep's shard_map) -------
+
+    @property
+    def max_priority(self) -> float:
+        """Global max-priority watermark across the shard trees. The
+        sharded megastep passes ONE watermark into its device program
+        (fresh rows on every shard enter at it before sampling) and
+        `reconcile_ingest` re-applies the same one to the mirror."""
+        if self.trees is None:
+            return 1.0
+        return float(max(t.max_priority for t in self.trees))
+
+    def scatter_local(
+        self,
+        storage_local: dict[str, jax.Array],
+        priorities_local: "jax.Array | None",
+        cursor: jax.Array,
+        blocks_local: tuple,
+        max_priority: jax.Array,
+    ):
+        """One shard's ring scatter + PER priority init, for use INSIDE
+        an enclosing `shard_map` body (the sharded megastep's fused
+        program). Same `ring_scatter` math as `_ingest_local`, plus the
+        priority bookkeeping the fused program needs before it samples:
+        fresh rows enter at the caller's max-priority watermark and the
+        trash row (local index cap_local) pins to 0 so sampling can
+        never return it. `priorities_local` is the shard's (stride,)
+        slice, or None for uniform replay.
+
+        Returns (new_storage, new_priorities, rows written)."""
+        new_storage, _, count, pos, keep = ring_scatter(
+            storage_local,
+            cursor,
+            blocks_local,
+            self.cap_local,
+            with_positions=True,
+        )
+        if priorities_local is not None:
+            priorities_local = priorities_local.at[pos].set(
+                jnp.where(keep, max_priority, 0.0)
+            )
+            priorities_local = priorities_local.at[self.cap_local].set(0.0)
+        return new_storage, priorities_local, count
+
+    def sample_local(
+        self,
+        priorities_local: jax.Array,
+        size: jax.Array,
+        k: int,
+        b_local: int,
+        key: jax.Array,
+        beta: jax.Array,
+    ):
+        """One shard's stratified (K, b_local) slot sampling inside an
+        enclosing `shard_map` body. PER: inclusive-cumsum + searchsorted
+        over the shard's own priority slice — the vectorized equivalent
+        of this shard's SumTree descent (utils/sumtree.py); zero-priority
+        (empty/trash) slots have empty cumsum segments and are never
+        selected. IS weights come back UNNORMALIZED — the caller
+        max-normalizes across the GLOBAL batch (a pmax over dp),
+        matching `sample`'s single batch-wide normalization. Uniform:
+        floor(u * size), unit weights.
+
+        Returns (local slot indices (K, b_local) int32, weights)."""
+        size_f = size.astype(jnp.float32)
+        if self.use_per:
+            cum = jnp.cumsum(priorities_local[: self.cap_local])
+            total = cum[-1]
+            u = (
+                (
+                    jnp.arange(b_local, dtype=jnp.float32)[None, :]
+                    + jax.random.uniform(key, (k, b_local))
+                )
+                / b_local
+                * total
+            )
+            idx = jnp.clip(
+                jnp.searchsorted(cum, u), 0, self.cap_local - 1
+            ).astype(jnp.int32)
+            probs = jnp.maximum(priorities_local[idx], 1e-12) / jnp.maximum(
+                total, 1e-12
+            )
+            weights = (size_f * probs) ** (-beta)
+        else:
+            u = jax.random.uniform(key, (k, b_local))
+            idx = jnp.clip(
+                jnp.floor(u * size_f).astype(jnp.int32),
+                0,
+                jnp.maximum(size - 1, 0),
+            )
+            weights = jnp.ones((k, b_local), jnp.float32)
+        return idx, weights
 
     # --- memory attribution (telemetry/memory.py) -------------------------
 

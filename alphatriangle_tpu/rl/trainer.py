@@ -392,6 +392,14 @@ class Trainer:
         calls on the same batches (same state threading, same RNG split
         sequence) — only the host round trips collapse to one.
 
+        This body is also the learner phase of the megastep program
+        families (rl/megastep.py). Under a dp-sharded stacked batch
+        (axis 1) with replicated params, XLA inserts the gradient
+        all-reduce over dp from the shardings — the "psum axis" of the
+        sharded megastep, with nothing spelled by hand (module
+        docstring) — so the updated params stay bit-identical on every
+        shard.
+
         The scan is fully unrolled on the CPU backend: XLA-CPU runs ops
         inside a While loop single-threaded, which makes a rolled scan
         ~15x slower per step than the identical unrolled program
@@ -410,6 +418,23 @@ class Trainer:
             unroll=True if jax.default_backend() == "cpu" else 1,
         )
         return state, metrics_k, td_k
+
+    @staticmethod
+    def _stacked_rows_batch(rows, weights) -> DenseBatch:
+        """(K, B, ...) ring rows -> the stacked DenseBatch the fused
+        steps consume. The grid int8->float32 cast reproduces the host
+        ring's storage round trip exactly. Shared by every gathered-
+        from-ring program: `_train_steps_from_impl`, the sharded-ring
+        gather below, and the megastep program families that embed the
+        fused steps (rl/megastep.py)."""
+        return {
+            "grid": rows["grid"].astype(jnp.float32),
+            "other_features": rows["other_features"],
+            "policy_target": rows["policy_target"],
+            "value_target": rows["value_target"],
+            "policy_weight": rows["policy_weight"],
+            "weights": weights,
+        }
 
     def _get_from_sharded_fn(self, buffer):
         """Jitted fused-steps program for the dp-SHARDED replay ring:
@@ -436,16 +461,12 @@ class Trainer:
 
             def impl(state, storage, idx, weights):
                 g = gather(storage, idx)
-                stacked: DenseBatch = {
-                    "grid": g["grid"].astype(jnp.float32),
-                    "other_features": g["other_features"],
-                    "policy_target": g["policy_target"],
-                    "value_target": g["value_target"],
-                    "policy_weight": g["policy_weight"],
-                    "weights": jax.lax.with_sharding_constraint(
+                stacked = self._stacked_rows_batch(
+                    g,
+                    jax.lax.with_sharding_constraint(
                         weights, self._stacked_shard
                     ),
-                }
+                )
                 return self._train_steps_impl(state, stacked)
 
             self._from_sharded_fns[key] = get_compile_cache().wrap(
@@ -460,17 +481,11 @@ class Trainer:
         """K fused steps whose batches are gathered from the device
         replay ring: `idx` is (K, B) int32 slot indices, `weights` the
         matching (K, B) IS weights. Bit-identical to `_train_steps_impl`
-        on the same rows (the grid int8->float32 cast reproduces the
-        host ring's storage round trip exactly)."""
-        stacked: DenseBatch = {
-            "grid": storage["grid"][idx].astype(jnp.float32),
-            "other_features": storage["other_features"][idx],
-            "policy_target": storage["policy_target"][idx],
-            "value_target": storage["value_target"][idx],
-            "policy_weight": storage["policy_weight"][idx],
-            "weights": weights,
-        }
-        return self._train_steps_impl(state, stacked)
+        on the same rows."""
+        rows = {name: v[idx] for name, v in storage.items()}
+        return self._train_steps_impl(
+            state, self._stacked_rows_batch(rows, weights)
+        )
 
     # --- host API ---------------------------------------------------------
 

@@ -266,6 +266,11 @@ def compose_budget(records) -> dict:
     between chunks) + the worst single program's transient (temp +
     output; the program-reported `peak` wins when present). Host rings
     are excluded: they live in host RAM, not HBM.
+
+    The budget is PER DEVICE: a dp-sharded ring's record carries the
+    global storage bytes over `shards` devices (each device holds only
+    its cap_local = capacity/dp rows plus one trash row), so device
+    ring totals divide by their shard count before entering the sum.
     """
     latest = latest_by_component(records)
     state = next(
@@ -278,7 +283,7 @@ def compose_budget(records) -> dict:
     params_bytes = int(((state or {}).get("bytes") or {}).get("params") or 0)
     state_total = int((state or {}).get("total") or 0)
     ring_device = sum(
-        int(r.get("total") or 0)
+        int(r.get("total") or 0) // max(1, int(r.get("shards") or 1))
         for r in rings
         if r.get("location") == "device"
     )
@@ -384,6 +389,27 @@ def attribution_rows(records) -> list:
 # --- pre-flight estimator (JAX-side; `cli fit`) --------------------------
 
 
+def sharded_megastep_dp(train_config) -> int:
+    """dp width the sharded megastep family (`megastep/dp<D>_t<T>_k<K>`)
+    would run at in THIS process: the device count when the geometry
+    divides like the training-time gate (training/setup.py's
+    `_make_buffer`), else 1 (the single-device family). Shared by
+    `estimate_fit` and `cli warm` so pre-flight and warm target the
+    program the run will actually dispatch."""
+    import jax
+
+    dp = jax.device_count()
+    if (
+        jax.process_count() == 1
+        and dp > 1
+        and train_config.BUFFER_CAPACITY % dp == 0
+        and train_config.BATCH_SIZE % dp == 0
+        and train_config.SELF_PLAY_BATCH_SIZE % dp == 0
+    ):
+        return dp
+    return 1
+
+
 def estimate_fit(
     env_config,
     model_config,
@@ -457,26 +483,67 @@ def estimate_fit(
         ),
     ]
     if megastep:
-        from ..rl.device_buffer import DeviceReplayBuffer
         from ..rl.megastep import MegastepRunner
 
-        mega_buffer = DeviceReplayBuffer(
-            train_config,
-            grid_shape=(
-                model_config.GRID_INPUT_CHANNELS,
-                env_config.ROWS,
-                env_config.COLS,
-            ),
-            other_dim=extractor.other_dim,
-            action_dim=env_config.action_dim,
+        grid_shape = (
+            model_config.GRID_INPUT_CHANNELS,
+            env_config.ROWS,
+            env_config.COLS,
         )
-        runner = MegastepRunner(engine, trainer, mega_buffer, train_config)
-        targets.append(
-            (
-                f"megastep/t{chunk}_k{fused_k}",
-                lambda: runner.analyze_megastep(chunk, fused_k),
+        mega_dp = sharded_megastep_dp(train_config)
+        if mega_dp > 1:
+            # dp-sharded family: analyze the program a multi-device run
+            # will actually dispatch, with dedicated mesh-built
+            # components mirroring training/setup.py's wiring. The
+            # ring record carries shards=dp so `compose_budget`
+            # charges each device its cap_local slice, not the global
+            # capacity.
+            from ..config.mesh_config import MeshConfig
+            from ..rl.sharded_device_buffer import (
+                ShardedDeviceReplayBuffer,
             )
-        )
+
+            mesh = MeshConfig(DP_SIZE=mega_dp).build_mesh()
+            mega_engine = SelfPlayEngine(
+                env, extractor, net, mcts_config, train_config,
+                seed=0, mesh=mesh,
+            )
+            mega_trainer = Trainer(net, train_config, mesh=mesh)
+            mega_buffer = ShardedDeviceReplayBuffer(
+                train_config,
+                grid_shape=grid_shape,
+                other_dim=extractor.other_dim,
+                action_dim=env_config.action_dim,
+                mesh=mesh,
+            )
+            records.append(mega_buffer.memory_record())
+            runner = MegastepRunner(
+                mega_engine, mega_trainer, mega_buffer, train_config
+            )
+            targets.append(
+                (
+                    f"megastep/dp{mega_dp}_t{chunk}_k{fused_k}",
+                    lambda: runner.analyze_megastep(chunk, fused_k),
+                )
+            )
+        else:
+            from ..rl.device_buffer import DeviceReplayBuffer
+
+            mega_buffer = DeviceReplayBuffer(
+                train_config,
+                grid_shape=grid_shape,
+                other_dim=extractor.other_dim,
+                action_dim=env_config.action_dim,
+            )
+            runner = MegastepRunner(
+                engine, trainer, mega_buffer, train_config
+            )
+            targets.append(
+                (
+                    f"megastep/t{chunk}_k{fused_k}",
+                    lambda: runner.analyze_megastep(chunk, fused_k),
+                )
+            )
     if serve:
         from ..serving import PolicyService, serve_program_name
 

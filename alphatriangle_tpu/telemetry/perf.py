@@ -81,12 +81,19 @@ class UtilizationMeter:
         train_step_flops: int = 0,
         device_kind: str = "",
         buffer_capacity: int = 0,
+        mesh_devices: int = 1,
         clock=time.monotonic,
     ) -> None:
         self.forward_flops = int(forward_flops)
         self.train_step_flops = int(train_step_flops)
         self.device_kind = device_kind
         self.buffer_capacity = int(buffer_capacity)
+        # Width of the mesh the dispatch counters run over. The gauge
+        # contract is MESH-LEVEL: one dispatch = one host-side program
+        # launch, regardless of how many devices execute it (a dp=8
+        # megastep iteration is still 1 dispatch, not 8) — so this is
+        # recorded beside the gauge, never multiplied into it.
+        self.mesh_devices = max(1, int(mesh_devices))
         peak, source = peak_bf16_tflops_info(device_kind)
         self.peak_tflops = peak
         self.peak_source = source
@@ -103,6 +110,7 @@ class UtilizationMeter:
             "device_kind": self.device_kind,
             "peak_bf16_tflops": self.peak_tflops,
             "peak_source": self.peak_source,
+            "mesh_devices": self.mesh_devices,
         }
 
     def tick(
@@ -205,9 +213,14 @@ class UtilizationMeter:
                 if total_compiles
                 else None
             ),
-            # Device-program dispatches per loop iteration: the host-
-            # round-trip gauge the fused megastep exists to collapse to
-            # 1.0 (sync runs ~3: rollout + ingest + learner group).
+            # Mesh-level program dispatches per loop iteration: the
+            # host-round-trip gauge the fused megastep exists to
+            # collapse to 1.0 (sync runs ~3: rollout + ingest + learner
+            # group). Counters tick once per host launch, NOT once per
+            # device execution — a dp-sharded megastep iteration is one
+            # dispatch whether the mesh has 1 device or 8; mesh_devices
+            # carries the width for readers that want per-device
+            # executions (gauge x mesh_devices).
             "dispatches_per_iteration": (
                 round(
                     max(0, d["dispatches"]) / d["iterations"], 3
@@ -215,6 +228,7 @@ class UtilizationMeter:
                 if d["iterations"] > 0
                 else None
             ),
+            "mesh_devices": self.mesh_devices,
         }
         if extra:
             record.update(extra)

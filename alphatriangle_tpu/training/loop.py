@@ -621,6 +621,24 @@ class TrainingLoop:
 
     # --- fused megastep (Anakin) ------------------------------------------
 
+    def _megastep_ready(self, need: int) -> bool:
+        """Warmup exit test: the ring can produce a training batch.
+
+        Sharded ring: EVERY shard must additionally cover its B/dp
+        stratum — the fused program samples per shard from device-local
+        priorities, so one under-filled shard would sample garbage rows
+        even when the global fill clears the threshold. (Warmup ingests
+        stripe each device's own lanes into its own shard, so shards
+        fill together; this is a correctness gate, not a throttle.)
+        """
+        buf = self.c.buffer
+        if len(buf) < need:
+            return False
+        if getattr(buf, "is_sharded", False):
+            b_local = self.cfg.BATCH_SIZE // buf.dp
+            return int(buf._sizes.min()) >= b_local
+        return True
+
     def _run_megastep_mode(self) -> None:
         """One device program per iteration: rollout chunk + ring
         ingest + on-device sampling + K learner steps (rl/megastep.py).
@@ -643,7 +661,9 @@ class TrainingLoop:
             self.c.megastep = self._megastep_runner = runner
         need = max(cfg.MIN_BUFFER_SIZE_TO_TRAIN, cfg.BATCH_SIZE)
         iteration = 0
-        while not self.stop_event.is_set() and len(self.c.buffer) < need:
+        while not self.stop_event.is_set() and not self._megastep_ready(
+            need
+        ):
             self.profile.on_iteration(iteration)
             iteration += 1
             with self.profile.phase("rollout"):

@@ -113,15 +113,6 @@ def _make_buffer(
     )
     mode = train_config.DEVICE_REPLAY
     single = jax.process_count() == 1 and mesh.devices.size == 1
-    if train_config.FUSED_MEGASTEP and not single:
-        # The megastep program samples and trains against the ONE
-        # device-resident ring; a dp-sharded megastep (per-device rings
-        # + shard_map sampling) is future work (docs/PARALLELISM.md).
-        raise ValueError(
-            "FUSED_MEGASTEP needs a single-device, single-process mesh "
-            f"(got {dict(mesh.shape)}, {jax.process_count()} "
-            "processes)."
-        )
     # First axis is data-parallel by convention (MeshConfig.build_mesh).
     dp = mesh.shape[mesh.axis_names[0]]
     sharded_ok = (
@@ -135,6 +126,19 @@ def _make_buffer(
         # a single-device engine's payload would crash the scatter.
         and train_config.SELF_PLAY_BATCH_SIZE % dp == 0
     )
+    if train_config.FUSED_MEGASTEP and not (single or sharded_ok):
+        # The megastep program samples and trains against a device-
+        # resident ring: either the single-device ring or the dp-
+        # sharded one (per-device ring shards + in-program shard_map
+        # sampling, rl/megastep.py) — anything else has no ring for
+        # the fused program to live in.
+        raise ValueError(
+            "FUSED_MEGASTEP needs a single-process mesh that is "
+            "single-device, or dp-only with BUFFER_CAPACITY, "
+            "BATCH_SIZE and SELF_PLAY_BATCH_SIZE divisible by dp "
+            f"(got {dict(mesh.shape)}, {jax.process_count()} "
+            "processes)."
+        )
     want = (
         mode == "on"
         or (mode == "auto" and jax.default_backend() != "cpu")
@@ -314,10 +318,11 @@ def setup_training_components(
         )
         logger.info(
             "Fused megastep mode: %d moves + %d learner steps per "
-            "device dispatch.",
+            "mesh dispatch (%d-way dp-sharded).",
             train_config.ROLLOUT_CHUNK_MOVES,
             train_config.LEARNER_STEPS_PER_ROLLOUT
             or max(1, train_config.FUSED_LEARNER_STEPS),
+            megastep_runner.dp,
         )
     # TensorBoard and the live-console JSONL are singleton host-side
     # work: process 0 only (N processes appending one shared file would
@@ -359,6 +364,11 @@ def setup_training_components(
         ),
         device_kind=str(getattr(device, "device_kind", device.platform)),
         buffer_capacity=train_config.BUFFER_CAPACITY,
+        # Gauge denominator contract: dispatch counters tally mesh-level
+        # program launches (one per host dispatch, however many devices
+        # the mesh spans), so the meter records the mesh width beside
+        # them instead of scaling them by it.
+        mesh_devices=mesh.devices.size,
     )
     telemetry = RunTelemetry(
         telemetry_config,

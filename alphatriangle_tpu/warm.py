@@ -162,6 +162,50 @@ def warm_bench_programs(
     targets.append(
         (f"megastep/t{plan.chunk}_k{plan.fused_k}", mega_fn)
     )
+    # dp-sharded megastep family (megastep/dp<D>_t<T>_k<K>): when this
+    # process has a multi-device mesh and the plan's geometry divides
+    # (same gate as training/setup.py), warm the program a sharded run
+    # will actually dispatch — mesh-built engine/trainer/ring, because
+    # the cache signature covers the shardings.
+    from .telemetry.memory import sharded_megastep_dp
+
+    mega_dp = sharded_megastep_dp(plan.train)
+    if mega_dp > 1:
+        mega_dp_fn = None
+        if trainer.aot_enabled:
+            from .config.mesh_config import MeshConfig
+            from .rl.megastep import MegastepRunner
+            from .rl.sharded_device_buffer import ShardedDeviceReplayBuffer
+
+            mesh = MeshConfig(DP_SIZE=mega_dp).build_mesh()
+            dp_engine = SelfPlayEngine(
+                env, extractor, net, plan.mcts, plan.train, seed=0,
+                mesh=mesh,
+            )
+            dp_trainer = Trainer(net, plan.train, mesh=mesh)
+            dp_ring = ShardedDeviceReplayBuffer(
+                plan.train,
+                grid_shape=(
+                    plan.model.GRID_INPUT_CHANNELS,
+                    plan.env.ROWS,
+                    plan.env.COLS,
+                ),
+                other_dim=extractor.other_dim,
+                action_dim=plan.env.action_dim,
+                mesh=mesh,
+            )
+            dp_runner = MegastepRunner(
+                dp_engine, dp_trainer, dp_ring, plan.train
+            )
+            mega_dp_fn = lambda: dp_runner.warm_megastep(
+                plan.chunk, plan.fused_k
+            )
+        targets.append(
+            (
+                f"megastep/dp{mega_dp}_t{plan.chunk}_k{plan.fused_k}",
+                mega_dp_fn,
+            )
+        )
     # Policy-service search shape (serving/service.py): warming
     # `serve/b<B>` is what turns `cli serve` startup from a flagship
     # search compile into a ~0.5s deserialize. The search program has

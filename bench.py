@@ -820,6 +820,128 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         extra["megastep"] = megastep_section
         emit(snapshot("megastep"))
 
+        # --- dp-sharded megastep scaling (megastep/dp<D>_t<T>_k<K>) -
+        # The same fused program sharded over the mesh's dp axis: each
+        # device runs its rollout lanes, scatters into its ring shard,
+        # samples its PER stratum and psums gradients in-program.
+        # Measures games/h + learner steps/s at 1 vs N devices and the
+        # vs_single_device ratio against the window just measured.
+        # BENCH_MEGASTEP_DP=0 skips (compile-budget escape hatch).
+        from alphatriangle_tpu.telemetry.memory import (
+            sharded_megastep_dp,
+        )
+
+        mega_dp = sharded_megastep_dp(train_cfg)
+        if (
+            os.environ.get("BENCH_MEGASTEP_DP", "1") != "0"
+            and mega_dp > 1
+        ):
+            from alphatriangle_tpu.config import MeshConfig
+            from alphatriangle_tpu.rl import SelfPlayEngine, Trainer
+            from alphatriangle_tpu.rl.sharded_device_buffer import (
+                ShardedDeviceReplayBuffer,
+            )
+
+            mesh = MeshConfig(DP_SIZE=mega_dp).build_mesh()
+            dp_engine = SelfPlayEngine(
+                env, extractor, net, mcts_cfg, train_cfg, seed=11,
+                mesh=mesh,
+            )
+            dp_trainer = Trainer(net, train_cfg, mesh=mesh)
+            dp_ring = ShardedDeviceReplayBuffer(
+                train_cfg,
+                grid_shape=(
+                    model_cfg.GRID_INPUT_CHANNELS,
+                    env_cfg.ROWS,
+                    env_cfg.COLS,
+                ),
+                other_dim=extractor.other_dim,
+                action_dim=env_cfg.action_dim,
+                mesh=mesh,
+            )
+            fill = batch["grid"].astype(np.int8).astype(np.float32)
+            for _ in range(
+                max(1, (train_cfg.MIN_BUFFER_SIZE_TO_TRAIN // b) + 1)
+            ):
+                dp_ring.add_dense(
+                    fill,
+                    batch["other_features"],
+                    batch["policy_target"],
+                    batch["value_target"],
+                )
+            dp_runner = MegastepRunner(
+                dp_engine, dp_trainer, dp_ring, train_cfg
+            )
+            log(
+                f"bench: compiling megastep dp{mega_dp}_t{chunk}"
+                f"_k{mega_k} (first dispatch)..."
+            )
+            t0 = time.time()
+            dp_runner.run_megastep(chunk, mega_k)
+            s_compile_s = time.time() - t0
+            dp_engine.harvest()
+            s_disp0 = dispatch_total(dp_trainer, dp_ring, dp_runner)
+            t0 = time.time()
+            s_moves = 0
+            s_steps = 0
+            s_iters = 0
+            while time.time() - t0 < mega_seconds:
+                dp_runner.run_megastep(chunk, mega_k)
+                s_moves += chunk
+                s_steps += mega_k
+                s_iters += 1
+            s_elapsed = time.time() - t0
+            s_dpi = (
+                dispatch_total(dp_trainer, dp_ring, dp_runner)
+                - s_disp0
+            ) / max(s_iters, 1)
+            s_result = dp_engine.harvest()
+            s_games_per_hour = (
+                s_result.num_episodes / s_elapsed * 3600.0
+            )
+            s_moves_per_sec = s_moves * sp_batch / s_elapsed
+            s_steps_per_sec = s_steps / s_elapsed
+            if m_games_per_hour > 0 and s_games_per_hour > 0:
+                vs_single = s_games_per_hour / m_games_per_hour
+                vs_single_basis = "games_per_hour"
+            else:
+                vs_single = (
+                    s_moves_per_sec / m_moves_per_sec
+                    if m_moves_per_sec > 0
+                    else None
+                )
+                vs_single_basis = "moves_per_sec"
+            scaling_section = {
+                "devices": mega_dp,
+                "seconds": round(s_elapsed, 1),
+                "iterations": s_iters,
+                "compile_seconds": round(s_compile_s, 1),
+                "games_per_hour": {
+                    "1": round(m_games_per_hour, 1),
+                    str(mega_dp): round(s_games_per_hour, 1),
+                },
+                "learner_steps_per_sec": {
+                    "1": round(m_steps_per_sec, 2),
+                    str(mega_dp): round(s_steps_per_sec, 2),
+                },
+                "moves_per_sec": round(s_moves_per_sec, 1),
+                "vs_single_device": (
+                    round(vs_single, 3) if vs_single else None
+                ),
+                "vs_single_device_basis": vs_single_basis,
+                "dispatches_per_iteration": round(s_dpi, 2),
+            }
+            log(f"bench: megastep scaling {scaling_section}")
+            megastep_section["scaling"] = scaling_section
+            emit(snapshot("megastep_scaling"))
+        elif mega_dp > 1:
+            log("bench: megastep scaling skipped (BENCH_MEGASTEP_DP=0)")
+        else:
+            log(
+                "bench: megastep scaling skipped (single device or "
+                "geometry does not divide the mesh)"
+            )
+
     # --- policy-serving latency (serving/service.py) --------------------
     # The serving front end's SLO numbers next to the training numbers:
     # simulated concurrent sessions with admit/retire churn through the

@@ -105,6 +105,99 @@ def tiny_configs():
     return env_cfg, model_cfg, mcts_cfg, train_cfg
 
 
+def dp_child(args) -> int:
+    """The 2-device dp-sharded megastep stage (runs in a subprocess).
+
+    The parent spawns this module with
+    `XLA_FLAGS=--xla_force_host_platform_device_count=2` so the CPU
+    backend presents two devices — the flag must be set before the
+    process's first jax import, hence a child process rather than a
+    stage in the parent. Runs a 4-step FUSED_MEGASTEP training loop
+    sharded over dp=2 and gates on the ledger's mesh-level dispatch
+    gauge: one host dispatch per iteration regardless of mesh width.
+    """
+    import json
+
+    import jax
+
+    if jax.device_count() < 2:
+        print(
+            f"perf-smoke[dp]: expected >=2 devices, got "
+            f"{jax.device_count()} — XLA_FLAGS not applied?",
+            file=sys.stderr,
+        )
+        return 2
+
+    from alphatriangle_tpu.config import (
+        MeshConfig,
+        PersistenceConfig,
+        TrainConfig,
+    )
+    from alphatriangle_tpu.training import run_training
+
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_configs()
+    dp_run = f"{RUN_NAME}_megastep_dp2"
+    dp_cfg = TrainConfig(
+        **{
+            **train_cfg.model_dump(),
+            "RUN_NAME": dp_run,
+            "FUSED_MEGASTEP": True,
+            "DEVICE_REPLAY": "on",
+            "FUSED_LEARNER_STEPS": 2,
+            "MAX_TRAINING_STEPS": 4,
+        }
+    )
+    dp_pc = PersistenceConfig(ROOT_DATA_DIR=args.root_dir, RUN_NAME=dp_run)
+    rc = run_training(
+        train_config=dp_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=dp_pc,
+        mesh_config=MeshConfig(DP_SIZE=2),
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(
+            f"perf-smoke[dp]: dp=2 megastep run failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+    ledger = dp_pc.get_run_base_dir() / "metrics.jsonl"
+    utils = [
+        r
+        for line in ledger.read_text().splitlines()
+        for r in [json.loads(line)]
+        if r.get("kind") == "util"
+        and isinstance(r.get("dispatches_per_iteration"), (int, float))
+    ]
+    if not utils:
+        print(
+            f"perf-smoke[dp]: {ledger} has no util record with "
+            "dispatches_per_iteration",
+            file=sys.stderr,
+        )
+        return 2
+    dpi = utils[-1]["dispatches_per_iteration"]
+    mesh_devices = utils[-1].get("mesh_devices")
+    # The gauge counts mesh-level program launches: a dp=2 iteration is
+    # still exactly ONE dispatch. mesh_devices is recorded beside it so
+    # readers can recover per-device executions.
+    if abs(dpi - 1.0) > 1e-6 or mesh_devices != 2:
+        print(
+            f"perf-smoke[dp]: expected dispatches_per_iteration=1.0 "
+            f"with mesh_devices=2, got {dpi} / {mesh_devices}",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"perf-smoke[dp]: dp=2 megastep ran; dispatches/iteration "
+        f"{dpi:.1f} across {mesh_devices} devices"
+    )
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -124,12 +217,20 @@ def main() -> int:
         action="store_true",
         help=f"Regenerate {REFERENCE.name} from this run's summary.",
     )
+    parser.add_argument(
+        "--dp-child",
+        action="store_true",
+        help=argparse.SUPPRESS,  # internal: the 2-device megastep stage
+    )
     args = parser.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    if args.dp_child:
+        return dp_child(args)
 
     from alphatriangle_tpu.cli import main as cli_main
     from alphatriangle_tpu.config import PersistenceConfig
@@ -246,6 +347,38 @@ def main() -> int:
         f"perf-smoke: megastep ran; dispatches/iteration "
         f"{mega_dpi[-1]:.1f} (last tick)"
     )
+
+    print("perf-smoke: dp-sharded megastep gate (2 devices)...", flush=True)
+    # The dp-sharded variant needs a 2-device backend, and
+    # --xla_force_host_platform_device_count only takes effect before a
+    # process's first jax import — so the stage runs in a child process
+    # (dp_child above) with its own XLA_FLAGS.
+    import subprocess
+
+    child_env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(REPO),
+    }
+    child = subprocess.run(
+        [
+            sys.executable,
+            str(Path(__file__).resolve()),
+            "--dp-child",
+            "--root-dir",
+            root,
+        ],
+        cwd=str(REPO),
+        env=child_env,
+        timeout=600,
+    )
+    if child.returncode != 0:
+        print(
+            f"perf-smoke: dp-sharded gate failed (rc={child.returncode})",
+            file=sys.stderr,
+        )
+        return child.returncode
 
     if args.write_reference:
         import contextlib

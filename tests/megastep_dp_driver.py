@@ -1,0 +1,221 @@
+"""Subprocess driver for the 8-way dp-sharded megastep dryrun.
+
+Launched by tests/test_megastep_sharded.py in its own process so it can
+force 8 virtual CPU devices before JAX initialises (the conftest
+process is already pinned to its own device count). Runs two phases:
+
+1. a single-device megastep run to step 4 (checkpoint + buffer spill),
+2. a dp=8 sharded megastep run that resumes from that single-device
+   checkpoint and continues to step 8.
+
+Phase 2 asserts the ISSUE's acceptance criteria in-process — one mesh
+dispatch per iteration, params bit-identical on all 8 shards after the
+fused K-step groups, per-shard device/host PER priority reconciliation
+— and prints marker lines (RESUME_STEP / GAUGE / MEGA_DP_OK) the
+parent test asserts on.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "ALPHATRIANGLE_AOT_CACHE_DIR",
+    tempfile.mkdtemp(prefix="mega_dp_aot_"),
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+
+def _configs(run_name: str, dp: int, max_steps: int):
+    from alphatriangle_tpu.config import (
+        AlphaTriangleMCTSConfig,
+        EnvConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+        expected_other_features_dim,
+    )
+
+    env_cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+        LINE_MIN_LENGTH=3,
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[4],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=0,
+        RESIDUAL_BLOCK_FILTERS=4,
+        USE_TRANSFORMER=False,
+        TRANSFORMER_DIM=8,
+        TRANSFORMER_HEADS=2,
+        TRANSFORMER_LAYERS=0,
+        TRANSFORMER_FC_DIM=16,
+        FC_DIMS_SHARED=[8],
+        POLICY_HEAD_DIMS=[8],
+        VALUE_HEAD_DIMS=[8],
+        NUM_VALUE_ATOMS=11,
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        COMPUTE_DTYPE="float32",
+        NORM_TYPE="group",
+    )
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=8,
+        max_depth=5,
+        cpuct=1.0,
+        dirichlet_alpha=0.3,
+        dirichlet_epsilon=0.25,
+        discount=1.0,
+        mcts_batch_size=4,
+    )
+    train_cfg = TrainConfig(
+        RUN_NAME=run_name,
+        AUTO_RESUME_LATEST=False,
+        MAX_TRAINING_STEPS=max_steps,
+        SELF_PLAY_BATCH_SIZE=8,
+        ROLLOUT_CHUNK_MOVES=2,
+        BATCH_SIZE=8,
+        BUFFER_CAPACITY=2000,
+        MIN_BUFFER_SIZE_TO_TRAIN=16,
+        USE_PER=True,
+        PER_BETA_ANNEAL_STEPS=8,
+        N_STEP_RETURNS=2,
+        WORKER_UPDATE_FREQ_STEPS=2,
+        CHECKPOINT_SAVE_FREQ_STEPS=2,
+        MAX_EPISODE_MOVES=30,
+        RANDOM_SEED=5,
+        FUSED_MEGASTEP=True,
+        DEVICE_REPLAY="on",
+        FUSED_LEARNER_STEPS=2,
+    )
+    return env_cfg, model_cfg, mcts_cfg, train_cfg, MeshConfig(DP_SIZE=dp)
+
+
+def _build(workdir: str, run_name: str, dp: int, max_steps: int):
+    from alphatriangle_tpu.config import PersistenceConfig
+    from alphatriangle_tpu.training import setup_training_components
+
+    env_cfg, model_cfg, mcts_cfg, train_cfg, mesh_cfg = _configs(
+        run_name, dp, max_steps
+    )
+    pc = PersistenceConfig(ROOT_DATA_DIR=workdir, RUN_NAME=run_name)
+    return setup_training_components(
+        train_config=train_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        mesh_config=mesh_cfg,
+        persistence_config=pc,
+        use_tensorboard=False,
+    )
+
+
+def main() -> None:
+    workdir = sys.argv[1]
+    run_name = "mega_dp8"
+
+    import json
+
+    import numpy as np
+
+    from alphatriangle_tpu.training import LoopStatus, TrainingLoop
+
+    assert len(jax.devices()) == 8, jax.devices()
+
+    # --- phase 1: single-device megastep run to step 4 -------------------
+    c1 = _build(workdir, run_name, dp=1, max_steps=4)
+    assert not getattr(c1.buffer, "is_sharded", False)
+    assert c1.megastep is not None and not c1.megastep.sharded
+    loop1 = TrainingLoop(c1)
+    status = loop1.run()
+    assert status == LoopStatus.COMPLETED, status
+    assert loop1.global_step == 4
+    c1.stats.close()
+    c1.checkpoints.close()
+    print(f"BASE_STEP={loop1.global_step}", flush=True)
+
+    # --- phase 2: dp=8 sharded run resumes the same checkpoints ---------
+    c2 = _build(workdir, run_name, dp=8, max_steps=8)
+    assert getattr(c2.buffer, "is_sharded", False), type(c2.buffer)
+    assert c2.megastep is not None and c2.megastep.sharded
+    assert c2.megastep.dp == 8
+    loop2 = TrainingLoop(c2)
+    loaded = c2.checkpoints.restore(c2.trainer.state, buffer=c2.buffer)
+    assert loaded.train_state is not None, "no checkpoint to resume"
+    assert loaded.buffer_loaded, "no buffer spill to resume"
+    c2.trainer.set_state(loaded.train_state)
+    c2.trainer.sync_to_network()
+    loop2.set_initial_state(
+        loaded.global_step,
+        int(loaded.counters.get("episodes_played", 0)),
+        int(loaded.counters.get("total_simulations", 0)),
+    )
+    print(f"RESUME_STEP={loaded.global_step}", flush=True)
+    assert loaded.global_step == 4
+
+    status = loop2.run()
+    assert status == LoopStatus.COMPLETED, status
+    assert loop2.global_step == 8
+
+    runner = c2.megastep
+    # One mesh-level dispatch per megastep iteration; the embedded
+    # learner never dispatched standalone programs.
+    assert runner.dispatch_count == loop2.megastep_iterations > 0
+    assert c2.trainer.dispatch_count == 0
+    print("DISPATCH_OK", flush=True)
+
+    # Params bit-identical across all 8 shards after the K-step groups.
+    for leaf in jax.tree_util.tree_leaves(c2.trainer.state.params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        assert len(shards) == 8, len(shards)
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+    print("PARAMS_OK", flush=True)
+
+    # Per-shard PER reconciliation: the device priority slice of every
+    # shard matches its host SumTree mirror.
+    buf = c2.buffer
+    prios = np.asarray(runner._priorities)
+    assert buf.trees is not None
+    for k, tree in enumerate(buf.trees):
+        sz = int(buf._sizes[k])
+        assert sz > 0, f"shard {k} never ingested"
+        dev = prios[k * buf.stride : k * buf.stride + sz]
+        host = tree.tree[np.arange(sz) + tree._cap2]
+        np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-6)
+    print("PER_OK", flush=True)
+
+    run_dir = c2.persistence_config.get_run_base_dir()
+    records = [
+        json.loads(line)
+        for line in (run_dir / "metrics.jsonl").read_text().splitlines()
+    ]
+    dpi = [
+        r["dispatches_per_iteration"]
+        for r in records
+        if r.get("kind") == "util"
+        and isinstance(r.get("dispatches_per_iteration"), (int, float))
+    ]
+    assert dpi, "no util records with dispatches_per_iteration"
+    print(f"GAUGE={dpi[-1]}", flush=True)
+    assert abs(dpi[-1] - 1.0) < 1e-9
+
+    assert c2.checkpoints.latest_step() == 8
+    c2.stats.close()
+    c2.checkpoints.close()
+    print("MEGA_DP_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

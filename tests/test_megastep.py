@@ -115,17 +115,20 @@ class TestConfigValidation:
         with pytest.raises(ValueError, match="device-resident replay"):
             make_cfg("bad", DEVICE_REPLAY="off")
 
-    def test_setup_rejects_multi_device_mesh(
+    def test_setup_rejects_non_divisible_dp_mesh(
         self, tmp_path, tiny_world_configs
     ):
+        # dp-sharded megastep meshes are accepted now, but only when
+        # the ring / batch / lane geometry divides evenly: dp=8 with
+        # SELF_PLAY_BATCH_SIZE=4 leaves the rollout lanes unshardable.
         env_cfg, model_cfg, mcts_cfg = tiny_world_configs
-        with pytest.raises(Exception, match="single-device"):
+        with pytest.raises(Exception, match="divisible by dp"):
             setup_training_components(
                 train_config=make_cfg("multi_mesh"),
                 env_config=env_cfg,
                 model_config=model_cfg,
                 mcts_config=mcts_cfg,
-                mesh_config=MeshConfig(DP_SIZE=4),
+                mesh_config=MeshConfig(DP_SIZE=8),
                 persistence_config=PersistenceConfig(
                     ROOT_DATA_DIR=str(tmp_path), RUN_NAME="multi_mesh"
                 ),
