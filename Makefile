@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke
 
 check: lint type test
 
@@ -64,3 +64,13 @@ perf-smoke:
 #   $(PY) benchmarks/serve_smoke.py --write-reference
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_smoke.py
+
+# Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
+# under a host-RAM byte limit must emit a tuned_preset.json that
+# `cli fit` independently confirms fits, whose winner out-predicts every
+# feasible rejected candidate, that `cli train --preset <artifact>
+# --dry-setup` can construct components from, and whose short real run
+# ledgers the predicted-vs-observed tune_outcome record the next
+# search's --calibrate reads.
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/tune_smoke.py

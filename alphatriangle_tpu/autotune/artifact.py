@@ -1,0 +1,169 @@
+"""Tuned-preset artifact + prediction-vs-observed outcome ledgering.
+
+`cli tune` emits `runs/<run>/tuned_preset.json`
+(`config.presets.TUNED_PRESET_SCHEMA`): the winning candidate's full
+config bundle plus the prediction, composed budget, calibration
+provenance and the search table. `config.presets.load_tuned_preset`
+round-trips it into a `baseline_preset`-shaped bundle that
+`cli train --preset <path>`, `cli warm <path>`, `cli fit <path>` and
+`bench.py` (BENCH_TUNED_PRESET) consume directly.
+
+After a run that consumed a tuned preset completes,
+`ledger_tune_outcome` appends a `kind:"tune_outcome"` record to the
+run's metrics ledger: predicted vs observed games/h and moves/s and
+their ratio. `calibration_from_targets` (autotune/model.py) folds those
+ratios back into the next search's efficiency term — the closed
+calibration loop the ISSUE names: each completed run sharpens the next
+search."""
+
+import json
+import logging
+import time
+from pathlib import Path
+
+from ..config.presets import TUNED_PRESET_SCHEMA
+
+logger = logging.getLogger(__name__)
+
+TUNE_OUTCOME_KIND = "tune_outcome"
+
+
+def build_tuned_preset(
+    result,
+    env_config,
+    model_config,
+    mcts_config,
+    train_config,
+    scale: str,
+    mode: str,
+    backend: str,
+    device_kind: str,
+    limit_bytes,
+    limit_source: str,
+    calibration,
+    run_name: str,
+) -> dict:
+    """The `tuned_preset.json` payload for a completed search with a
+    winner. `result` is the TuneResult; the configs are the WINNING
+    candidate's materialized configs (not the base plan's)."""
+    cand = result.best
+    if cand is None:
+        raise ValueError("build_tuned_preset needs a feasible winner")
+    return {
+        "schema": TUNED_PRESET_SCHEMA,
+        "created": time.time(),
+        "run_name": run_name,
+        "description": (
+            f"autotuned {scale} ({mode}) on {backend}"
+            f"{f'/{device_kind}' if device_kind else ''}: "
+            f"{cand.label()}"
+        ),
+        "scale": scale,
+        "mode": mode,
+        "backend": backend,
+        "device_kind": device_kind,
+        "candidate": {
+            "geometry": cand.geometry,
+            "sp_batch": cand.sp_batch,
+            "capacity": cand.capacity,
+            "chunk": cand.chunk,
+            "fused_k": cand.fused_k,
+            "dp": cand.dp,
+        },
+        "configs": {
+            "env": env_config.model_dump(),
+            "model": model_config.model_dump(),
+            "mcts": mcts_config.model_dump(),
+            "train": train_config.model_dump(),
+        },
+        "predicted": result.best_prediction,
+        "budget": result.best_budget,
+        "limit_bytes": limit_bytes,
+        "limit_source": limit_source,
+        "calibration": (
+            calibration.as_dict() if calibration is not None else None
+        ),
+        "search": {
+            "rows": result.rows,
+            "oracle_calls": result.oracle_calls,
+            "evaluated": result.evaluated,
+        },
+    }
+
+
+def write_tuned_preset(payload: dict, out_path) -> Path:
+    """Write the artifact (parents created); returns the path."""
+    path = Path(out_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2, default=str))
+    return path
+
+
+def default_artifact_path(run_name: str, root_dir=None) -> Path:
+    """`runs/<run_name>/tuned_preset.json` under the runs root (the
+    same resolution `cli perf`/`cli mem` use for run names)."""
+    from ..config.persistence_config import PersistenceConfig
+
+    persistence = PersistenceConfig(RUN_NAME=run_name)
+    if root_dir:
+        persistence = persistence.model_copy(
+            update={"ROOT_DATA_DIR": str(root_dir)}
+        )
+    return persistence.get_run_base_dir() / "tuned_preset.json"
+
+
+def ledger_tune_outcome(run_dir, tuned_payload: dict) -> "dict | None":
+    """Append predicted-vs-observed throughput to a completed run's
+    metrics ledger.
+
+    Reads the run's util records (tolerantly — telemetry/perf.py),
+    aligns observed games/h and moves/s against the tuned preset's
+    prediction, and appends one `kind:"tune_outcome"` JSON line to the
+    run's metrics.jsonl. Returns the record, or None when the run has
+    no ledger at all (nothing to anchor the observation to). A run too
+    short to produce util records still gets a record with null
+    observed fields — the prediction provenance is worth keeping."""
+    from ..telemetry.ledger import read_ledger, resolve_ledger_path
+    from ..telemetry.perf import summarize_utilization
+
+    run_dir = Path(run_dir)
+    ledger = resolve_ledger_path(run_dir)
+    if ledger is None:
+        logger.warning(
+            "tune: no metrics ledger under %s; outcome not recorded",
+            run_dir,
+        )
+        return None
+    summary = summarize_utilization(read_ledger(ledger)) or {}
+    predicted = tuned_payload.get("predicted") or {}
+    record: dict = {
+        "kind": TUNE_OUTCOME_KIND,
+        "time": time.time(),
+        "tuned_run_name": tuned_payload.get("run_name"),
+        "schema": tuned_payload.get("schema"),
+        "candidate": tuned_payload.get("candidate"),
+        "predicted_games_per_hour": predicted.get("games_per_hour"),
+        "predicted_moves_per_sec": predicted.get("moves_per_sec"),
+        "observed_games_per_hour": summary.get("games_per_hour"),
+        "observed_moves_per_sec": summary.get("moves_per_sec"),
+        "observed_mfu": summary.get("mfu"),
+    }
+    pred = record["predicted_games_per_hour"]
+    obs = record["observed_games_per_hour"]
+    if (
+        isinstance(pred, (int, float))
+        and isinstance(obs, (int, float))
+        and pred > 0
+        and obs > 0
+    ):
+        record["observed_over_predicted"] = obs / pred
+    with ledger.open("a") as fh:
+        fh.write(json.dumps(record) + "\n")
+    logger.info(
+        "tune: outcome ledgered to %s (predicted %.1f games/h, "
+        "observed %s)",
+        ledger,
+        pred if isinstance(pred, (int, float)) else float("nan"),
+        f"{obs:.1f}" if isinstance(obs, (int, float)) else "n/a",
+    )
+    return record

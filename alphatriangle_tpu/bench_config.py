@@ -40,15 +40,70 @@ class BenchPlan:
     extras: dict = field(default_factory=dict)
 
 
+def plan_from_tuned_preset(
+    path: str, smoke: bool, backend: str, environ=None
+) -> BenchPlan:
+    """BenchPlan from a `tuned_preset.json` artifact (`cli tune`).
+
+    The plan's shapes come from the artifact's winning configs, so
+    `cli warm <path>`, `cli fit <path>` and a BENCH_TUNED_PRESET bench
+    run compile/measure EXACTLY the program shapes the tuned run will
+    dispatch. Raises SystemExit on schema mismatch/garbled artifacts
+    (same fail-loud contract as BENCH_RECIPE)."""
+    env = os.environ if environ is None else environ
+    from .config import load_tuned_preset
+
+    try:
+        bundle = load_tuned_preset(path)
+    except ValueError as exc:
+        raise SystemExit(str(exc)) from exc
+    payload = bundle["tuned"]
+    train_cfg = bundle["train"]
+    mode = payload.get("mode", "sync")
+    device_replay = bool(
+        train_cfg.FUSED_MEGASTEP
+        or train_cfg.DEVICE_REPLAY == "on"
+        or (
+            train_cfg.DEVICE_REPLAY == "auto"
+            and backend != "cpu"
+            and not smoke
+        )
+    )
+    fused_k = train_cfg.FUSED_LEARNER_STEPS
+    sp_batch = train_cfg.SELF_PLAY_BATCH_SIZE
+    return BenchPlan(
+        env=bundle["env"],
+        model=bundle["model"],
+        mcts=bundle["mcts"],
+        train=train_cfg,
+        scale=f"tuned_{payload.get('scale', 'preset')}",
+        sims=bundle["mcts"].max_simulations,
+        sp_batch=sp_batch,
+        chunk=train_cfg.ROLLOUT_CHUNK_MOVES,
+        lbatch=train_cfg.BATCH_SIZE,
+        description=str(bundle["description"]),
+        fused_k=fused_k,
+        overlap_k=fused_k,
+        device_replay=device_replay,
+        serve_batch=int(env.get("BENCH_SERVE_SLOTS") or sp_batch),
+        extras={"tuned_preset": str(path), "mode": mode},
+    )
+
+
 def resolve_bench_plan(
     smoke: bool, backend: str, environ=None
 ) -> BenchPlan:
     """Build the measurement configs for this (backend, env) pair.
 
     Raises SystemExit on a mislabeled-measurement request (unknown
-    BENCH_RECIPE), exactly like the bench always has.
+    BENCH_RECIPE), exactly like the bench always has. BENCH_TUNED_PRESET
+    (a `tuned_preset.json` path from `cli tune`) wins over every other
+    knob: the plan then measures the tuned shapes verbatim.
     """
     env = os.environ if environ is None else environ
+    tuned = env.get("BENCH_TUNED_PRESET")
+    if tuned:
+        return plan_from_tuned_preset(tuned, smoke, backend, environ=env)
     from .config import (
         AlphaTriangleMCTSConfig,
         EnvConfig,

@@ -38,12 +38,19 @@ def _add_train_parser(sub: "argparse._SubParsersAction") -> None:
     )
     p.add_argument(
         "--preset",
-        type=int,
         default=None,
-        metavar="N",
-        choices=[1, 2, 3, 4, 5],
-        help="BASELINE benchmark config 1..5 (config/presets.py); "
-        "explicit flags below override preset values.",
+        metavar="N|PATH",
+        help="BASELINE benchmark config 1..5 (config/presets.py) OR a "
+        "tuned_preset.json path from `cli tune`; explicit flags below "
+        "override preset values. Tuned-preset runs ledger a "
+        "predicted-vs-observed tune_outcome record on completion.",
+    )
+    p.add_argument(
+        "--dry-setup",
+        action="store_true",
+        help="Construct every training component (mesh, network, "
+        "buffer, loop threads' inputs) from the resolved config, then "
+        "exit 0 without training — proves a tuned preset is runnable.",
     )
     # TPU-native sizing knobs.
     p.add_argument("--max-steps", type=int, default=None)
@@ -258,10 +265,21 @@ def cmd_train(args: argparse.Namespace) -> int:
         telemetry_config = TelemetryConfig(**t_kw)
 
     env_config = model_config = mcts_config = mesh_config = None
+    tuned_payload = None
     if args.preset is not None:
-        from .config import baseline_preset
+        preset = str(args.preset)
+        if preset.isdigit():
+            from .config import baseline_preset
 
-        bundle = baseline_preset(args.preset, run_name=args.run_name)
+            bundle = baseline_preset(int(preset), run_name=args.run_name)
+        else:
+            from .config import load_tuned_preset
+
+            try:
+                bundle = load_tuned_preset(preset)
+            except ValueError as exc:
+                raise SystemExit(f"--preset: {exc}") from exc
+            tuned_payload = bundle.get("tuned")
         env_config = bundle["env"]
         model_config = bundle["model"]
         mcts_config = bundle["mcts"]
@@ -310,7 +328,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             NUM_PROCESSES=args.num_processes,
             PROCESS_ID=args.process_id,
         )
-    return run_training(
+    rc = run_training(
         train_config=train_config,
         env_config=env_config,
         model_config=model_config,
@@ -321,7 +339,28 @@ def cmd_train(args: argparse.Namespace) -> int:
         telemetry_config=telemetry_config,
         log_level=args.log_level,
         use_tensorboard=not args.no_tensorboard,
+        dry_setup=args.dry_setup,
     )
+    if rc == 0 and tuned_payload is not None and not args.dry_setup:
+        # Close the autotuner's loop: ledger predicted-vs-observed so
+        # the next `cli tune --calibrate` sharpens its model
+        # (docs/AUTOTUNE.md).
+        from .autotune import ledger_tune_outcome
+
+        p_cfg = persistence_config or PersistenceConfig(
+            RUN_NAME=train_config.RUN_NAME
+        )
+        record = ledger_tune_outcome(
+            p_cfg.get_run_base_dir(), tuned_payload
+        )
+        if record is not None:
+            ratio = record.get("observed_over_predicted")
+            print(
+                "tune-outcome: observed/predicted games/h = "
+                f"{ratio if ratio is not None else 'n/a'} "
+                "(ledgered for future `cli tune --calibrate`)."
+            )
+    return rc
 
 
 def _launch_ui(tool: str, argv: list[str], module: str | None = None) -> int:
@@ -568,8 +607,10 @@ def cmd_perf(args: argparse.Namespace) -> int:
     if ledger is None:
         print(f"no metrics ledger at {args.run}", file=sys.stderr)
         return 2
+    # No kinds= pre-filter: summarize_utilization itself tolerates
+    # kind-less legacy util ticks that the filter would drop.
     summary = summarize_utilization(
-        read_ledger(ledger, kinds={"util"}), window=args.window
+        read_ledger(ledger), window=args.window
     )
     if summary is None:
         print(
@@ -1121,6 +1162,29 @@ def cmd_play(args: argparse.Namespace) -> int:
         print(f"reward {reward:+.1f}")
 
 
+_BENCH_TARGETS = ("auto", "smoke", "cpu", "1", "2", "3", "4", "5")
+
+
+def _apply_bench_target(target: "str | None", environ: dict) -> None:
+    """Map a warm/fit/tune target onto the bench-plan env knobs:
+    digits 1..5 select a BASELINE preset (BENCH_CONFIG), a path to a
+    `cli tune` artifact selects the tuned shapes (BENCH_TUNED_PRESET);
+    auto/smoke/cpu leave the ambient BENCH_* knobs in charge."""
+    if not target or target in ("auto", "smoke", "cpu"):
+        return
+    if target.isdigit():
+        environ["BENCH_CONFIG"] = target
+        return
+    if Path(target).is_file():
+        environ["BENCH_TUNED_PRESET"] = target
+        return
+    raise SystemExit(
+        f"Unknown target {target!r}: expected one of "
+        f"{'|'.join(_BENCH_TARGETS)} or a tuned_preset.json path "
+        "(emitted by `cli tune`)."
+    )
+
+
 def cmd_warm(args: argparse.Namespace) -> int:
     """AOT-precompile the hot bench/training programs for a preset so a
     later bench/run starts measuring in seconds instead of burning its
@@ -1154,9 +1218,9 @@ def cmd_warm(args: argparse.Namespace) -> int:
 
     environ = dict(_os.environ)
     smoke = args.target == "smoke" or environ.get("BENCH_SMOKE") == "1"
-    if args.target and args.target.isdigit():
-        environ["BENCH_CONFIG"] = args.target
-    # target auto/cpu/smoke: honor ambient BENCH_* knobs as bench does.
+    # target auto/cpu/smoke: honor ambient BENCH_* knobs as bench does;
+    # digits select a BASELINE preset, a path selects a tuned preset.
+    _apply_bench_target(args.target, environ)
     plan = resolve_bench_plan(smoke, backend, environ=environ)
     programs = set(args.programs.split(",")) if args.programs else None
     report = warm_bench_programs(
@@ -1456,12 +1520,11 @@ def cmd_fit(args: argparse.Namespace) -> int:
     import jax
 
     from .bench_config import resolve_bench_plan
-    from .telemetry.health import device_memory_stats
     from .telemetry.memory import (
-        BYTES_LIMIT_ENV,
         estimate_fit,
         fit_verdict,
         fmt_bytes,
+        resolve_bytes_limit,
     )
     from .utils.helpers import enable_persistent_compilation_cache
 
@@ -1469,8 +1532,7 @@ def cmd_fit(args: argparse.Namespace) -> int:
     enable_persistent_compilation_cache(backend=backend)
     environ = dict(_os.environ)
     smoke = args.target == "smoke" or environ.get("BENCH_SMOKE") == "1"
-    if args.target and args.target.isdigit():
-        environ["BENCH_CONFIG"] = args.target
+    _apply_bench_target(args.target, environ)
     plan = resolve_bench_plan(smoke, backend, environ=environ)
     print(
         f"fit: backend={backend} scale={plan.scale} batch={plan.sp_batch} "
@@ -1500,34 +1562,13 @@ def cmd_fit(args: argparse.Namespace) -> int:
     budget = report["budget"]
     # Per-device byte limit: explicit flag wins, then the env override,
     # then the smallest limit any local device reports (conservative).
-    limit = None
-    source = "none"
-    override = environ.get(BYTES_LIMIT_ENV, "").strip()
-    if args.limit_gb is not None:
-        limit, source = args.limit_gb * 2**30, "flag"
-    elif override:
-        try:
-            limit, source = float(override), "env"
-        except ValueError:
-            print(
-                f"{BYTES_LIMIT_ENV}={override!r} is not a number; "
-                "ignoring.",
-                file=sys.stderr,
-            )
-    if limit is None:
-        limits = [
-            m.get("bytes_limit")
-            for m in device_memory_stats()
-            if isinstance(m.get("bytes_limit"), (int, float))
-            and m.get("bytes_limit") > 0
-        ]
-        if limits:
-            limit, source = min(limits), "device"
+    limit, source = resolve_bytes_limit(args.limit_gb, environ)
     code, reason = fit_verdict(budget["total_bytes"], limit)
     if args.json:
         print(
             _json.dumps(
                 {
+                    "schema": "alphatriangle.fit.v1",
                     "scale": plan.scale,
                     "backend": backend,
                     "budget": budget,
@@ -1645,104 +1686,263 @@ def cmd_mem(args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_tune(args: argparse.Namespace) -> int:
-    """On-hardware self-play shape autotuner.
+def _tune_axes(
+    scale: str, plan, smoke: bool, device_count: int
+) -> "tuple[list, list, list, list, list]":
+    """Default (batches, capacities, chunks, fused_ks, dps) per scale.
 
-    Sweeps (SELF_PLAY_BATCH_SIZE, ROLLOUT_CHUNK_MOVES) cells on the
-    actual backend, measuring moves/s and games/hour per cell, and
-    recommends the best. TPU throughput is shape-sensitive (MXU tiling,
-    dispatch amortization) in ways no static heuristic predicts — this
-    replaces guesswork when bringing the framework up on new hardware.
-    No reference equivalent (its worker count is a CPU-core heuristic,
-    `alphatriangle/training/setup.py:106-151`).
+    Grids bracket the scale's plan shapes: the point of the search is
+    to discover how much LARGER than the hand-picked config the chip
+    can actually go, so each axis extends above the plan value. Smoke
+    keeps the lattice tiny — `make tune-smoke` pays a couple of
+    estimate_fit compiles, not a sweep."""
+    b0 = plan.sp_batch
+    cap0 = plan.train.BUFFER_CAPACITY
+    t0 = plan.chunk
+    k0 = plan.fused_k
+    if smoke:
+        batches = [max(4, b0 // 2), b0]
+        capacities = [cap0]
+        chunks = [t0]
+        fused_ks = [k0]
+    elif scale == "cpu":
+        batches = [b0 // 2, b0, b0 * 2]
+        capacities = [cap0, cap0 * 2]
+        chunks = [t0, t0 * 2]
+        fused_ks = [k0]
+    else:
+        batches = [b0 // 2, b0, b0 * 2, b0 * 4]
+        capacities = [cap0, cap0 * 5, cap0 * 10]
+        chunks = [t0, t0 * 2]
+        fused_ks = [k0, k0 * 2]
+    dps = [1]
+    if device_count > 1 and not smoke:
+        dps.append(device_count)
+    return batches, capacities, chunks, fused_ks, dps
+
+
+def cmd_tune(args: argparse.Namespace) -> int:
+    """Fit-driven offline autotuner (docs/AUTOTUNE.md).
+
+    Searches the (SELF_PLAY_BATCH_SIZE, BUFFER_CAPACITY, chunk T,
+    fused K, dp, geometry) space for the feasible config maximizing
+    PREDICTED games/hour — feasibility from `estimate_fit`'s AOT
+    memory analysis (programs are compiled, never executed; no chip
+    window is burned), the objective from the analytic FLOPs model
+    calibrated against ledger history (`--calibrate`). Emits
+    `runs/<run>/tuned_preset.json`, consumable by `cli train --preset`,
+    `cli warm`, `cli fit` and `bench.py` (BENCH_TUNED_PRESET).
+
+    Exit 0: winner found + artifact written. Exit 1: no feasible
+    candidate under the limit. Exit 2: no device byte limit known
+    (set --limit-gb or ALPHATRIANGLE_DEVICE_BYTES_LIMIT).
     """
     import json as _json
-    import time
+    import os as _os
 
     from .utils.helpers import enforce_platform
 
-    enforce_platform(args.device or "auto")
+    device = args.device or ("cpu" if args.target == "cpu" else "auto")
+    enforce_platform(device)
 
     import jax
 
-    from .config import (
-        AlphaTriangleMCTSConfig,
-        EnvConfig,
-        ModelConfig,
-        TrainConfig,
-        expected_other_features_dim,
+    from .autotune import (
+        SearchSpace,
+        build_tuned_preset,
+        calibration_from_targets,
+        default_artifact_path,
+        run_search,
+        write_tuned_preset,
     )
-    from .env.engine import TriangleEnv
-    from .features.core import get_feature_extractor
-    from .nn.network import NeuralNetwork
-    from .rl import SelfPlayEngine
+    from .bench_config import resolve_bench_plan
+    from .telemetry.memory import (
+        FIT_OVER,
+        FIT_UNKNOWN,
+        fmt_bytes,
+        resolve_bytes_limit,
+    )
+    from .utils.flops import peak_bf16_tflops_info
     from .utils.helpers import enable_persistent_compilation_cache
 
     backend = jax.default_backend()
-    # Backend now resolved: safe to gate the persistent compile cache
-    # correctly (an auto run that landed on CPU must not cache).
     enable_persistent_compilation_cache(backend=backend)
-    env_cfg = EnvConfig()
-    model_cfg = ModelConfig(
-        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
-        COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+    environ = dict(_os.environ)
+    smoke = (
+        args.target == "smoke"
+        or args.smoke
+        or environ.get("BENCH_SMOKE") == "1"
     )
-    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
-    env = TriangleEnv(env_cfg)
-    extractor = get_feature_extractor(env, model_cfg)
-    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    _apply_bench_target(args.target, environ)
+    plan = resolve_bench_plan(smoke, backend, environ=environ)
 
-    batches = [int(b) for b in args.batches.split(",")]
-    chunks = [int(c) for c in args.chunks.split(",")]
-    print(
-        f"tune: backend={backend} sims={args.sims} "
-        f"cells={len(batches) * len(chunks)} "
-        f"({args.seconds_per_cell:.0f}s each + compile)"
+    limit, limit_source = resolve_bytes_limit(args.limit_gb, environ)
+    if limit is None:
+        print(
+            "tune: no per-device byte limit known — pass --limit-gb or "
+            "set ALPHATRIANGLE_DEVICE_BYTES_LIMIT (a search without a "
+            "memory budget has no feasibility oracle).",
+            file=sys.stderr,
+        )
+        return FIT_UNKNOWN
+
+    device_kind = jax.devices()[0].device_kind
+    peak, peak_source = peak_bf16_tflops_info(device_kind)
+    device_count = jax.device_count()
+
+    # Loop mode being tuned: the fused megastep when the plan would run
+    # it (device ring available), else the sync loop. CPU/smoke tunes
+    # sync — the megastep still dispatches on CPU but its learner
+    # programs cannot AOT there (rl/trainer.py cpu_aot).
+    mode = args.mode
+    if mode == "auto":
+        mode = "megastep" if plan.device_replay else "sync"
+
+    batches, capacities, chunks, fused_ks, dps = _tune_axes(
+        plan.scale, plan, smoke, device_count
     )
-    rows = []
-    for b in batches:
-        for chunk in chunks:
-            train_cfg = TrainConfig(
-                SELF_PLAY_BATCH_SIZE=b,
-                ROLLOUT_CHUNK_MOVES=chunk,
-                RUN_NAME="tune",
+    if args.batches:
+        batches = [int(v) for v in args.batches.split(",")]
+    if args.capacities:
+        capacities = [int(v) for v in args.capacities.split(",")]
+    if args.chunks:
+        chunks = [int(v) for v in args.chunks.split(",")]
+    if args.fused_k:
+        fused_ks = [int(v) for v in args.fused_k.split(",")]
+    if args.dp:
+        dps = [int(v) for v in args.dp.split(",")]
+    geometries = (
+        args.geometries.split(",") if args.geometries else ["plan"]
+    )
+    space = SearchSpace(
+        geometries=geometries,
+        batches=batches,
+        capacities=capacities,
+        chunks=chunks,
+        fused_ks=fused_ks,
+        dps=dps,
+    )
+
+    calibration = calibration_from_targets(
+        args.calibrate or [], root_dir=args.root_dir
+    )
+    def say(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    say(
+        f"tune: backend={backend} scale={plan.scale} mode={mode} "
+        f"space={space.size()} candidates limit={fmt_bytes(limit)} "
+        f"[{limit_source}] peak={peak or 'unknown'} TFLOP/s "
+        f"[{peak_source}] calibration={','.join(calibration.sources)}"
+    )
+
+    result = run_search(
+        space,
+        plan.env,
+        plan.model,
+        plan.mcts,
+        plan.train,
+        limit,
+        calibration=calibration,
+        peak_tflops=peak,
+        mode=mode,
+        device_replay=plan.device_replay or mode == "megastep",
+        progress=say,
+    )
+
+    run_name = args.run_name or f"tune_{plan.scale}"
+    payload = None
+    out_path = None
+    if result.best is not None:
+        from .autotune.search import materialize_candidate
+
+        env_cfg, model_cfg, train_cfg = materialize_candidate(
+            result.best, plan.env, plan.model, plan.train, mode
+        )
+        train_cfg = train_cfg.model_copy(update={"RUN_NAME": run_name})
+        payload = build_tuned_preset(
+            result,
+            env_cfg,
+            model_cfg,
+            plan.mcts,
+            train_cfg,
+            scale=plan.scale,
+            mode=mode,
+            backend=backend,
+            device_kind=device_kind,
+            limit_bytes=limit,
+            limit_source=limit_source,
+            calibration=calibration,
+            run_name=run_name,
+        )
+        out_path = Path(
+            args.out
+            or default_artifact_path(run_name, root_dir=args.root_dir)
+        )
+        write_tuned_preset(payload, out_path)
+
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "schema": "alphatriangle.tune_report.v1",
+                    "scale": plan.scale,
+                    "backend": backend,
+                    "mode": mode,
+                    "bytes_limit": limit,
+                    "limit_source": limit_source,
+                    "rows": result.rows,
+                    "oracle_calls": result.oracle_calls,
+                    "best": payload,
+                    "artifact": str(out_path) if out_path else None,
+                    "exit": 0 if result.best is not None else FIT_OVER,
+                },
+                default=str,
             )
-            engine = SelfPlayEngine(
-                env, extractor, net, mcts_cfg, train_cfg, seed=0
+        )
+    else:
+        hdr = (
+            f"{'geometry':<9} {'B':>6} {'cap':>8} {'T':>4} {'K':>4} "
+            f"{'dp':>3} {'pred games/h':>13} {'budget':>10}  status"
+        )
+        print(f"tune {plan.scale} on {backend} (mode {mode})")
+        print(hdr)
+        for row in result.rows:
+            pred = row["predicted"] or {}
+            gph = pred.get("games_per_hour")
+            gph_s = (
+                f"{gph:.1f}" if isinstance(gph, (int, float)) else "n/a"
             )
-            t0 = time.time()
-            engine.play_chunk(chunk)
-            compile_s = time.time() - t0
-            engine.harvest()
-            t0 = time.time()
-            moves = 0
-            while time.time() - t0 < args.seconds_per_cell:
-                engine.play_chunk(chunk)
-                moves += chunk
-            elapsed = time.time() - t0
-            episodes = engine.harvest().num_episodes
-            row = {
-                "batch": b,
-                "chunk": chunk,
-                "moves_per_sec": round(moves * b / elapsed, 1),
-                "games_per_hour": round(episodes / elapsed * 3600.0, 1),
-                "compile_s": round(compile_s, 1),
-            }
-            rows.append(row)
-            print(_json.dumps(row), flush=True)
-            del engine
-    # Short windows can complete zero episodes in every cell;
-    # moves/s breaks the tie.
-    best = max(
-        rows, key=lambda r: (r["games_per_hour"], r["moves_per_sec"])
-    )
-    print(
-        f"tune: best games/hour at --self-play-batch {best['batch']} "
-        f"--rollout-chunk {best['chunk']} "
-        f"({best['games_per_hour']:.0f} games/h, "
-        f"{best['moves_per_sec']:.0f} moves/s)"
-    )
-    return 0
+            budget = row["budget_total_bytes"]
+            budget_s = fmt_bytes(budget) if budget else "n/a"
+            detail = f" ({row['detail']})" if row["detail"] else ""
+            print(
+                f"{row['geometry']:<9} {row['sp_batch']:>6} "
+                f"{row['capacity']:>8} {row['chunk']:>4} "
+                f"{row['fused_k']:>4} {row['dp']:>3} {gph_s:>13} "
+                f"{budget_s:>10}  {row['status']}{detail}"
+            )
+        if result.best is not None:
+            pred = result.best_prediction or {}
+            print(
+                f"tune: best {result.best.label()} — predicted "
+                f"{pred.get('games_per_hour', 0.0):.1f} games/h, "
+                f"budget {fmt_bytes(result.best_budget['total_bytes'])} "
+                f"of {fmt_bytes(limit)} "
+                f"({result.oracle_calls} oracle call(s))"
+            )
+            print(f"tune: wrote {out_path}")
+            print(
+                f"tune: consume with `cli train --preset {out_path}`, "
+                f"`cli warm {out_path}`, or BENCH_TUNED_PRESET={out_path}"
+            )
+        else:
+            print(
+                f"tune: no feasible candidate under {fmt_bytes(limit)} "
+                f"({result.oracle_calls} oracle call(s), "
+                f"{len(result.rows)} candidates examined)"
+            )
+    return 0 if result.best is not None else FIT_OVER
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1916,10 +2116,10 @@ def main(argv: list[str] | None = None) -> int:
         "target",
         nargs="?",
         default="auto",
-        choices=["auto", "smoke", "cpu", "1", "2", "3", "4", "5"],
         help="What to warm: 'auto' = the bench scale for this backend "
         "(honors ambient BENCH_* knobs), 'smoke'/'cpu' = the reduced "
-        "scales, 1..5 = a BASELINE preset (config/presets.py).",
+        "scales, 1..5 = a BASELINE preset (config/presets.py), or a "
+        "tuned_preset.json path from `cli tune`.",
     )
     warm.add_argument(
         "--jobs",
@@ -1951,10 +2151,10 @@ def main(argv: list[str] | None = None) -> int:
         "target",
         nargs="?",
         default="auto",
-        choices=["auto", "smoke", "cpu", "1", "2", "3", "4", "5"],
         help="Scale to check: 'auto' = the bench scale for this "
         "backend (honors ambient BENCH_* knobs), 'smoke'/'cpu' = the "
-        "reduced scales, 1..5 = a BASELINE preset.",
+        "reduced scales, 1..5 = a BASELINE preset, or a "
+        "tuned_preset.json path from `cli tune`.",
     )
     fit.add_argument(
         "--limit-gb",
@@ -2095,17 +2295,96 @@ def main(argv: list[str] | None = None) -> int:
 
     tune = sub.add_parser(
         "tune",
-        help="Sweep self-play batch/chunk shapes on this hardware and "
-        "recommend the fastest.",
+        help="Fit-driven offline autotuner: search batch/capacity/"
+        "chunk/K/dp/geometry for the feasible config maximizing "
+        "predicted games/h — AOT memory analysis as the oracle, no "
+        "chip execution — and emit a tuned_preset.json "
+        "(docs/AUTOTUNE.md).",
     )
     tune.add_argument(
-        "--batches", default="256,512,1024", help="Comma-separated lane counts."
+        "target",
+        nargs="?",
+        default="auto",
+        help="Base scale to search around: 'auto' = the bench scale "
+        "for this backend, 'smoke'/'cpu' = the reduced scales, "
+        "1..5 = a BASELINE preset.",
     )
     tune.add_argument(
-        "--chunks", default="8,16", help="Comma-separated chunk lengths."
+        "--limit-gb",
+        type=float,
+        default=None,
+        metavar="GIB",
+        help="Per-device byte limit (GiB) the search must fit under "
+        "(default: backend-reported; also "
+        "ALPHATRIANGLE_DEVICE_BYTES_LIMIT, bytes).",
     )
-    tune.add_argument("--sims", type=int, default=64)
-    tune.add_argument("--seconds-per-cell", type=float, default=20.0)
+    tune.add_argument(
+        "--smoke",
+        action="store_true",
+        help="Tiny lattice for CI: a couple of oracle compiles, not a "
+        "sweep (make tune-smoke).",
+    )
+    tune.add_argument(
+        "--json",
+        action="store_true",
+        help="Emit the full search report (rows + winner) as JSON.",
+    )
+    tune.add_argument(
+        "--out",
+        default=None,
+        metavar="PATH",
+        help="Write tuned_preset.json here "
+        "(default: runs/<run-name>/tuned_preset.json).",
+    )
+    tune.add_argument("--run-name", default=None)
+    tune.add_argument("--root-dir", default=None)
+    tune.add_argument(
+        "--batches",
+        default=None,
+        help="Override the SELF_PLAY_BATCH_SIZE axis (comma-separated).",
+    )
+    tune.add_argument(
+        "--capacities",
+        default=None,
+        help="Override the BUFFER_CAPACITY axis (comma-separated).",
+    )
+    tune.add_argument(
+        "--chunks",
+        default=None,
+        help="Override the rollout chunk T axis (comma-separated).",
+    )
+    tune.add_argument(
+        "--fused-k",
+        default=None,
+        help="Override the fused learner K axis (comma-separated).",
+    )
+    tune.add_argument(
+        "--dp",
+        default=None,
+        help="Override the data-parallel shard axis (comma-separated).",
+    )
+    tune.add_argument(
+        "--geometries",
+        default=None,
+        help="Board geometry presets to search (comma-separated names "
+        "from config.GEOMETRY_PRESETS, or 'plan' = the scale's board).",
+    )
+    tune.add_argument(
+        "--calibrate",
+        action="append",
+        default=None,
+        metavar="RUN_OR_JSON",
+        help="Calibrate the throughput model against these runs / perf "
+        "summaries (repeatable; accepts anything `cli perf compare` "
+        "does). Default: the model's conservative built-ins.",
+    )
+    tune.add_argument(
+        "--mode",
+        default="auto",
+        choices=["auto", "sync", "megastep"],
+        help="Loop shape being tuned (auto = megastep when the bench "
+        "plan would run device replay).",
+    )
     tune.add_argument(
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )

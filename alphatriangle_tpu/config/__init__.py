@@ -7,8 +7,12 @@ from alphatriangle_tpu.config.mesh_config import MeshConfig
 from alphatriangle_tpu.config.model_config import ModelConfig
 from alphatriangle_tpu.config.persistence_config import PersistenceConfig
 from alphatriangle_tpu.config.presets import (
+    GEOMETRY_PRESETS,
     PRESET_DESCRIPTIONS,
+    TUNED_PRESET_SCHEMA,
     baseline_preset,
+    geometry_preset,
+    load_tuned_preset,
 )
 from alphatriangle_tpu.config.telemetry_config import TelemetryConfig
 from alphatriangle_tpu.config.train_config import TrainConfig
@@ -21,14 +25,18 @@ __all__ = [
     "APP_NAME",
     "AlphaTriangleMCTSConfig",
     "EnvConfig",
+    "GEOMETRY_PRESETS",
     "MCTSConfig",
     "MeshConfig",
     "ModelConfig",
     "PRESET_DESCRIPTIONS",
     "PersistenceConfig",
+    "TUNED_PRESET_SCHEMA",
     "TelemetryConfig",
     "TrainConfig",
     "baseline_preset",
     "expected_other_features_dim",
+    "geometry_preset",
+    "load_tuned_preset",
     "print_config_info_and_validate",
 ]

@@ -30,6 +30,13 @@ from .model_config import ModelConfig
 from .train_config import TrainConfig
 from .validation import expected_other_features_dim
 
+# Versioned schema tag for `tuned_preset.json` artifacts written by the
+# fit-driven autotuner (alphatriangle_tpu/autotune/). Bump when the
+# artifact layout changes incompatibly; `load_tuned_preset` refuses
+# mismatched versions with an explicit error instead of constructing a
+# half-understood config.
+TUNED_PRESET_SCHEMA = "alphatriangle.tuned_preset.v1"
+
 PRESET_DESCRIPTIONS = {
     1: "CNN-only, 50 sims, CPU smoke (BASELINE config 1)",
     2: "CNN-only, 200 sims, single TPU core (BASELINE config 2)",
@@ -53,6 +60,104 @@ def _large_board() -> EnvConfig:
         inset = max(0, d)
         ranges.append((inset, cols - inset))
     return EnvConfig(ROWS=rows, COLS=cols, PLAYABLE_RANGE_PER_ROW=ranges)
+
+
+def _tiny_board() -> EnvConfig:
+    """3x4 fully-playable board, 1 preview slot — the test-world
+    geometry (tests/conftest.py) as a named preset so the autotuner can
+    search it cheaply."""
+    return EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+        LINE_MIN_LENGTH=3,
+    )
+
+
+# Named board geometries the autotuner's search space can range over
+# (docs/AUTOTUNE.md). Values are zero-arg constructors so importing
+# this module never validates configs eagerly.
+GEOMETRY_PRESETS = {
+    "tiny": _tiny_board,
+    "default": EnvConfig,
+    "large": _large_board,
+}
+
+
+def geometry_preset(name: str) -> EnvConfig:
+    """EnvConfig for a named board geometry preset."""
+    if name not in GEOMETRY_PRESETS:
+        raise ValueError(
+            f"Unknown geometry preset {name!r} "
+            f"(valid: {', '.join(sorted(GEOMETRY_PRESETS))})"
+        )
+    return GEOMETRY_PRESETS[name]()
+
+
+def load_tuned_preset(path) -> dict[str, object]:
+    """Round-trip a `tuned_preset.json` artifact into a
+    `baseline_preset`-shaped bundle {env, model, train, mcts, mesh,
+    description, tuned}.
+
+    `tuned` carries the artifact payload itself (schema, predicted
+    throughput, composed budget, search provenance) so consumers like
+    `cli train --preset <path>` can ledger predicted-vs-observed
+    outcomes after the run. Raises ValueError with a precise reason on
+    a missing/garbled file or a schema version mismatch — a tuned
+    preset from an incompatible autotuner must fail loudly, not
+    half-construct.
+    """
+    import json
+    from pathlib import Path
+
+    p = Path(path)
+    try:
+        payload = json.loads(p.read_text())
+    except OSError as exc:
+        raise ValueError(f"tuned preset {p}: unreadable ({exc})") from exc
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"tuned preset {p}: invalid JSON ({exc})") from exc
+    if not isinstance(payload, dict):
+        raise ValueError(f"tuned preset {p}: expected a JSON object")
+    schema = payload.get("schema")
+    if schema != TUNED_PRESET_SCHEMA:
+        raise ValueError(
+            f"tuned preset {p}: schema {schema!r} does not match this "
+            f"build's {TUNED_PRESET_SCHEMA!r} — re-run `cli tune` with "
+            "the current code instead of reusing a stale artifact."
+        )
+    configs = payload.get("configs")
+    if not isinstance(configs, dict):
+        raise ValueError(f"tuned preset {p}: missing 'configs' section")
+    try:
+        env = EnvConfig(**configs["env"])
+        model = ModelConfig(**configs["model"])
+        train = TrainConfig(**configs["train"])
+        mcts = AlphaTriangleMCTSConfig(**configs["mcts"])
+    except KeyError as exc:
+        raise ValueError(
+            f"tuned preset {p}: configs section missing {exc}"
+        ) from exc
+    except Exception as exc:
+        raise ValueError(
+            f"tuned preset {p}: config validation failed ({exc})"
+        ) from exc
+    return {
+        "env": env,
+        "model": model,
+        "train": train,
+        "mcts": mcts,
+        # The artifact records the dp width it tuned FOR; DP_SIZE=-1
+        # still resolves to the devices actually present so the preset
+        # runs anywhere (same contract as the BASELINE presets).
+        "mesh": MeshConfig(DP_SIZE=-1),
+        "description": payload.get(
+            "description", f"tuned preset ({p.name})"
+        ),
+        "tuned": payload,
+    }
 
 
 def baseline_preset(

@@ -389,6 +389,41 @@ def attribution_rows(records) -> list:
 # --- pre-flight estimator (JAX-side; `cli fit`) --------------------------
 
 
+def resolve_bytes_limit(
+    limit_gb: "float | None", environ=None
+) -> tuple:
+    """(per-device byte limit, source) with the `cli fit` resolution
+    order shared by fit/tune/serve: an explicit --limit-gb flag wins,
+    then the ALPHATRIANGLE_DEVICE_BYTES_LIMIT env override, then the
+    smallest limit any local device reports (conservative on
+    heterogeneous hosts). (None, "none") when nothing is known —
+    FIT_UNKNOWN territory."""
+    import os
+
+    env = os.environ if environ is None else environ
+    if limit_gb is not None:
+        return limit_gb * 2**30, "flag"
+    override = str(env.get(BYTES_LIMIT_ENV, "") or "").strip()
+    if override:
+        try:
+            return float(override), "env"
+        except ValueError:
+            logger.warning(
+                "%s=%r is not a number; ignoring.", BYTES_LIMIT_ENV, override
+            )
+    from .health import device_memory_stats
+
+    limits = [
+        m.get("bytes_limit")
+        for m in device_memory_stats()
+        if isinstance(m.get("bytes_limit"), (int, float))
+        and m.get("bytes_limit") > 0
+    ]
+    if limits:
+        return min(limits), "device"
+    return None, "none"
+
+
 def sharded_megastep_dp(train_config) -> int:
     """dp width the sharded megastep family (`megastep/dp<D>_t<T>_k<K>`)
     would run at in THIS process: the device count when the geometry
@@ -420,10 +455,18 @@ def estimate_fit(
     megastep: bool = False,
     serve: bool = False,
     serve_batch: "int | None" = None,
+    programs: "set[str] | None" = None,
     progress=None,
 ) -> dict:
     """Build the run's hot programs AOT (lowered + compiled, never
     executed) and compose the static memory budget for them.
+
+    `programs`: optional name filter (substring match against the
+    program labels, same contract as `cli warm --programs`) — the
+    autotuner's feasibility oracle analyzes only the programs that
+    bound its candidate's budget instead of paying every compile per
+    search point. Static records (train state, replay ring) are always
+    composed regardless of the filter.
 
     Returns {"records": [...], "budget": compose_budget(...)}. The
     device-replay gather program is not lowered here — lowering it
@@ -573,6 +616,12 @@ def estimate_fit(
                 lambda: service.analyze(persist=True),
             )
         )
+    if programs:
+        targets = [
+            (label, fn)
+            for label, fn in targets
+            if any(p in label for p in programs)
+        ]
     for label, fn in targets:
         t0 = time.time()
         try:

@@ -309,11 +309,31 @@ def summarize_utilization(
 
     `window` keeps only the newest N records (the whole run otherwise).
     None when no usable records exist (schema failure for callers).
+
+    Tolerates historical ledgers: runs recorded before the `kind`
+    field (or before the serve/mem/dispatch gauges) still summarize —
+    a kind-less record counts as a util tick when it carries any core
+    throughput field; fields added later simply come out None and
+    `compare_summaries` renders them "n/a" instead of skipping the run.
     """
+    _UTIL_SIGNATURE = (
+        "moves_per_sec",
+        "learner_steps_per_sec",
+        "games_per_hour",
+        "step_time_ms",
+        "mfu",
+    )
     records = [
         r
         for r in records
-        if isinstance(r, dict) and r.get("kind") == "util"
+        if isinstance(r, dict)
+        and (
+            r.get("kind") == "util"
+            or (
+                "kind" not in r
+                and any(k in r for k in _UTIL_SIGNATURE)
+            )
+        )
     ]
     if not records:
         return None
@@ -471,7 +491,10 @@ def load_comparable(
         ledger = resolve_ledger_path(run_dir) if run_dir else None
     if ledger is None:
         return None, f"{target}: no metrics ledger found"
-    summary = summarize_utilization(read_ledger(ledger, kinds={"util"}))
+    # Read ALL records (no kinds= pre-filter): ledgers written before
+    # the `kind` field exist, and the pre-filter would drop their
+    # util ticks before the tolerant summarize above ever saw them.
+    summary = summarize_utilization(read_ledger(ledger))
     if summary is None:
         return None, f"{ledger}: no utilization records"
     # Static memory budget from the run's attribution records, so

@@ -68,8 +68,14 @@ def run_training(
     telemetry_config: TelemetryConfig | None = None,
     log_level: str = "INFO",
     use_tensorboard: bool = True,
+    dry_setup: bool = False,
 ) -> int:
-    """Run a full training session; returns a process exit code."""
+    """Run a full training session; returns a process exit code.
+
+    `dry_setup` stops after component construction (mesh, network,
+    buffer, trainer, telemetry) and returns 0 without training — the
+    cheapest end-to-end proof that a config (e.g. a `cli tune` preset)
+    is actually runnable on this backend (`cli train --dry-setup`)."""
     setup_logging(log_level)
     train_config = train_config or TrainConfig()
     # Must precede any backend init (a site hook can override the env
@@ -118,6 +124,16 @@ def run_training(
     except Exception:
         logger.exception("Component setup failed.")
         return 1
+
+    if dry_setup:
+        components.stats.close()
+        components.checkpoints.close()
+        logger.info(
+            "Dry setup OK: components constructed for run '%s' "
+            "(no training performed).",
+            train_config.RUN_NAME,
+        )
+        return 0
 
     loop = TrainingLoop(components)
     try:

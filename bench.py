@@ -34,6 +34,9 @@ with backoff across a total budget: first success wins.
 
 Env knobs:
   BENCH_SMOKE=1         shrink everything for a fast CPU sanity run
+  BENCH_TUNED_PRESET=P  bench the shapes from a tuned_preset.json
+                        emitted by `cli tune` (wins over every other
+                        shape knob; docs/AUTOTUNE.md)
   BENCH_SECONDS=N       override the self-play measurement window
   BENCH_INIT_TIMEOUT=N  per-attempt probe timeout in seconds (default 120)
   BENCH_INIT_BUDGET=N   total probe budget across retries (default 900)

@@ -11,11 +11,18 @@
 #                   (default: bash benchmarks/tpu_round4.sh)
 #   WATCH_WARM_S    budget for the post-probe compile-cache warm
 #                   (default 900; 0 disables warming)
+#   WATCH_TUNE_S    budget for the offline autotune step (default 600;
+#                   0 disables). Runs `cli tune auto` — AOT memory
+#                   analysis only, no chip execution beyond compiles —
+#                   and, when it lands a tuned_preset.json, warms THAT
+#                   config's shapes too so a tuned run launched in the
+#                   same window starts hot (docs/AUTOTUNE.md).
 set -u
 cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + ${WATCH_BUDGET_S:-21600} ))
 cmd=${WATCH_CMD:-"bash benchmarks/tpu_round4.sh"}
 warm_s=${WATCH_WARM_S:-900}
+tune_s=${WATCH_TUNE_S:-600}
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     # Probe passed: warm the compile caches (XLA persistent + AOT
@@ -33,6 +40,21 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       echo "$(date +%T) chip healthy; warming compile caches (<=${warm_s}s)" >&2
       timeout "$warm_s" python -m alphatriangle_tpu.cli warm auto >&2 \
         || echo "$(date +%T) warm incomplete (continuing)" >&2
+    fi
+    # Offline autotune: spends HBM analysis (AOT compiles), not the
+    # chip window — the tuned preset is the config the next real run
+    # should use, so pre-warm its shapes while the chip is healthy.
+    # Best-effort like the warm: never blocks the sweep attempt.
+    if [ "$tune_s" -gt 0 ]; then
+      tuned=.alphatriangle_data/AlphaTriangleTPU/runs/tune_auto/tuned_preset.json
+      echo "$(date +%T) chip healthy; autotuning (<=${tune_s}s)" >&2
+      if timeout "$tune_s" python -m alphatriangle_tpu.cli tune auto \
+           --run-name tune_auto >&2 && [ -f "$tuned" ]; then
+        timeout "$warm_s" python -m alphatriangle_tpu.cli warm "$tuned" >&2 \
+          || echo "$(date +%T) tuned warm incomplete (continuing)" >&2
+      else
+        echo "$(date +%T) tune incomplete (continuing)" >&2
+      fi
     fi
     echo "$(date +%T) chip healthy; running: $cmd" >&2
     if eval "$cmd"; then
