@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke
 
 check: lint type test
 
@@ -64,6 +64,17 @@ perf-smoke:
 #   $(PY) benchmarks/serve_smoke.py --write-reference
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_smoke.py
+
+# Window-forensics gate (docs/OBSERVABILITY.md "Flight recorder"):
+# a synthetic torn flight ring must classify as dispatch-hung naming
+# the exact program, a simulated over-deadline dispatch (frozen clock,
+# exit-on-wedge off) must land wedge_report.json + stacks and doctor
+# the same way, and sealed flight records must surface as per-program
+# device-time rows in `cli perf --json`. Runs the doctor CLI in
+# subprocesses exactly as tpu_watch.sh does — JAX is never imported on
+# that path.
+doctor-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/doctor_smoke.py
 
 # Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
 # under a host-RAM byte limit must emit a tuned_preset.json that

@@ -64,14 +64,19 @@ class Calibration:
     `efficiency` is achieved MFU; `moves_per_game` converts moves/s to
     games/h; `outcome_scale` multiplies predictions by the observed/
     predicted ratio of past tuned runs (`kind:"tune_outcome"` records),
-    so every completed run sharpens the next search. `sources` records
-    where each term came from for the artifact's provenance block.
+    so every completed run sharpens the next search. `family_seconds`
+    is measured p50 dispatch wall per program family (rollout /
+    learner / megastep / serve) from the run's flight ring
+    (telemetry/flight.py) — ground truth the analytic FLOP model can
+    be sanity-checked against. `sources` records where each term came
+    from for the artifact's provenance block.
     """
 
     efficiency: float = DEFAULT_EFFICIENCY
     moves_per_game: "float | None" = None
     overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S
     outcome_scale: float = 1.0
+    family_seconds: dict = field(default_factory=dict)
     sources: list = field(default_factory=lambda: ["defaults"])
 
     def as_dict(self) -> dict:
@@ -80,6 +85,7 @@ class Calibration:
             "moves_per_game": self.moves_per_game,
             "overhead_s_per_dispatch": self.overhead_s,
             "outcome_scale": self.outcome_scale,
+            "family_seconds": dict(self.family_seconds),
             "sources": list(self.sources),
         }
 
@@ -156,13 +162,20 @@ def merge_calibrations(calibrations: list) -> Calibration:
     ]
     scales = [c.outcome_scale for c in cals]
     sources: list = []
+    fam_samples: dict = {}
     for c in cals:
         sources.extend(c.sources)
+        for fam, secs in (c.family_seconds or {}).items():
+            if isinstance(secs, (int, float)):
+                fam_samples.setdefault(fam, []).append(float(secs))
     return Calibration(
         efficiency=sum(effs) / len(effs),
         moves_per_game=(sum(mpgs) / len(mpgs)) if mpgs else None,
         overhead_s=cals[0].overhead_s,
         outcome_scale=sum(scales) / len(scales),
+        family_seconds={
+            fam: sum(v) / len(v) for fam, v in fam_samples.items()
+        },
         sources=sources,
     )
 
@@ -204,6 +217,22 @@ def calibration_from_targets(
                     ratio = rec.get("observed_over_predicted")
                     if isinstance(ratio, (int, float)) and ratio > 0:
                         ratios.append(float(ratio))
+                # Measured per-family dispatch walls from the run's
+                # flight ring (telemetry/flight.py): DISPATCH_OVERHEAD
+                # was unfittable analytically, but sealed records carry
+                # the real dispatch->fetch seconds per family.
+                from ..telemetry.flight import (
+                    FLIGHT_FILENAME,
+                    family_seconds,
+                    read_flight,
+                )
+
+                fams = family_seconds(
+                    read_flight(ledger.parent / FLIGHT_FILENAME)
+                )
+                if fams:
+                    cal.family_seconds = fams
+                    cal.sources.append(f"flight x{len(fams)}")
         if ratios:
             cal.outcome_scale = sum(ratios) / len(ratios)
             cal.sources.append(f"tune_outcome x{len(ratios)}")

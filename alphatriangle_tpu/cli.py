@@ -538,9 +538,11 @@ def cmd_watch(args: argparse.Namespace) -> int:
     from .stats.watch import (
         WatchState,
         render_frame,
+        tail_flight,
         tail_ledger_utils,
         tail_live_metrics,
     )
+    from .telemetry.flight import FLIGHT_FILENAME
     from .telemetry.health import read_health
 
     run_dir = _resolve_run_dir(args.run_name, args.root_dir)
@@ -548,10 +550,12 @@ def cmd_watch(args: argparse.Namespace) -> int:
         return 1
     live = run_dir / "live_metrics.jsonl"
     ledger = run_dir / "metrics.jsonl"
+    flight = run_dir / FLIGHT_FILENAME
     heartbeat = run_dir / "health.json"
     state = WatchState()
     offset = tail_live_metrics(live, state, 0)
     ledger_offset = tail_ledger_utils(ledger, state, 0)
+    flight_offset = tail_flight(flight, state, 0)
     if not live.exists():
         print(
             f"waiting for {live} (run still starting?) — Ctrl-C to stop",
@@ -566,6 +570,7 @@ def cmd_watch(args: argparse.Namespace) -> int:
             _time.sleep(args.interval)
             offset = tail_live_metrics(live, state, offset)
             ledger_offset = tail_ledger_utils(ledger, state, ledger_offset)
+            flight_offset = tail_flight(flight, state, flight_offset)
             # Redraw in place: move up over the previous frame.
             height = frame.count("\n") + 1
             frame = render_frame(
@@ -630,6 +635,14 @@ def cmd_perf(args: argparse.Namespace) -> int:
         if budget["total_bytes"] > 0:
             mem_budget = budget["total_bytes"]
             summary["memory_budget_bytes"] = mem_budget
+    # Per-program device time from the flight recorder's sealed
+    # records (telemetry/flight.py): measured dispatch->fetch walls
+    # per compiled program, the rows `cli tune --calibrate` feeds on.
+    from .telemetry.flight import FLIGHT_FILENAME, read_flight, summarize_flight
+
+    programs = summarize_flight(read_flight(ledger.parent / FLIGHT_FILENAME))
+    if programs:
+        summary["programs"] = programs
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -695,6 +708,20 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   fill {_fmt_cell(summary.get('serve_batch_fill'), ',.0f', 100.0, '%')}"
             f"   reloads {_fmt_cell(summary.get('serve_weight_reloads'), ',.0f')}"
         )
+    if programs:
+        # Measured per-program device time (flight recorder seals) —
+        # busiest first; errors are ok:false seals (failed dispatches).
+        width = max(max(len(p["program"]) for p in programs), 7)
+        print(f"  {'program':<{width}}  {'count':>6}  {'p50':>9}  {'p95':>9}  {'total':>9}  err")
+        for p in programs:
+            print(
+                f"  {p['program']:<{width}}"
+                f"  {p['count']:>6}"
+                f"  {_fmt_cell(p['wall_s_p50'], ',.1f', 1e3, 'ms'):>9}"
+                f"  {_fmt_cell(p['wall_s_p95'], ',.1f', 1e3, 'ms'):>9}"
+                f"  {_fmt_cell(p['wall_s_total'], ',.1f', 1, 's'):>9}"
+                f"  {p['errors']}"
+            )
     print(
         f"  trend        {_fmt_cell(trend, '+,.1f', 100.0, '%')} "
         "(2nd-half vs 1st-half throughput)"
@@ -1686,6 +1713,72 @@ def cmd_mem(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """Postmortem window forensics: classify how a run ended from its
+    on-disk evidence alone (flight ring + health.json + wedge report +
+    metrics ledger). Never imports JAX — safe to run beside (or after)
+    a wedged chip, which is the whole point: the chip window that
+    produced the artifacts may be unusable.
+
+    Exit code IS the verdict (telemetry/flight.py DOCTOR_EXIT_CODES):
+    0 clean, 2 never-started, 3 compile-hung, 4 dispatch-hung,
+    5 host-stall, 6 oom. `benchmarks/tpu_watch.sh` appends the verdict
+    to its cumulative windows.jsonl per reclaimed window."""
+    import json as _json
+
+    from .telemetry.flight import (
+        FLIGHT_FILENAME,
+        WEDGE_REPORT_FILENAME,
+        classify_run,
+        read_flight,
+        read_wedge_report,
+    )
+    from .telemetry.health import read_health
+    from .telemetry.ledger import read_ledger, resolve_ledger_path
+
+    target = Path(args.run) if args.run else None
+    if target is not None and target.exists():
+        run_dir = target if target.is_dir() else target.parent
+    else:
+        run_dir = _resolve_run_dir(args.run, args.root_dir)
+        if run_dir is None:
+            return 2
+    flight = read_flight(run_dir / FLIGHT_FILENAME)
+    health = read_health(run_dir / "health.json")
+    wedge = read_wedge_report(run_dir / WEDGE_REPORT_FILENAME)
+    ledger = resolve_ledger_path(run_dir)
+    utils = read_ledger(ledger, kinds={"util"}) if ledger else []
+    verdict = classify_run(flight, health=health, utils=utils, wedge=wedge)
+    if args.json:
+        verdict["run_dir"] = str(run_dir)
+        print(_json.dumps(verdict))
+        return int(verdict["exit_code"])
+    ev = verdict["evidence"]
+    print(f"doctor {run_dir}")
+    print(
+        f"  verdict   {verdict['verdict']}"
+        + (
+            f"  ({verdict['program']} [{verdict['family']}])"
+            if verdict.get("program")
+            else ""
+        )
+    )
+    if verdict.get("detail"):
+        print(f"  detail    {verdict['detail']}")
+    print(
+        f"  evidence  {ev['intents']} intents, {ev['seals']} seals, "
+        f"{ev['unsealed']} unsealed"
+        + (", wedge report" if ev["wedge_report"] else "")
+        + (", stalled heartbeat" if ev["stalled"] else "")
+        + (
+            f", mem {ev['mem_utilization']:.0%}"
+            if isinstance(ev.get("mem_utilization"), float)
+            else ""
+        )
+    )
+    return int(verdict["exit_code"])
+
+
 def _tune_axes(
     scale: str, plan, smoke: bool, device_count: int
 ) -> "tuple[list, list, list, list, list]":
@@ -1975,6 +2068,27 @@ def main(argv: list[str] | None = None) -> int:
     watch.add_argument("--interval", type=float, default=2.0)
     watch.add_argument(
         "--once", action="store_true", help="Render one frame and exit."
+    )
+
+    doctor = sub.add_parser(
+        "doctor",
+        help="Postmortem window forensics from the flight ring + "
+        "health.json + wedge report — names the program a dead run "
+        "hung inside; exit code is the verdict. No JAX import.",
+    )
+    doctor.add_argument(
+        "run",
+        nargs="?",
+        default=None,
+        help="Run name, run dir, or flight.jsonl path "
+        "(default: latest run).",
+    )
+    doctor.add_argument("--root-dir", default=None)
+    doctor.add_argument(
+        "--json",
+        action="store_true",
+        help="Emit the verdict as one JSON line (tpu_watch.sh appends "
+        "it to windows.jsonl).",
     )
 
     health = sub.add_parser(
@@ -2411,6 +2525,7 @@ def main(argv: list[str] | None = None) -> int:
         "devices": cmd_devices,
         "watch": cmd_watch,
         "health": cmd_health,
+        "doctor": cmd_doctor,
         "perf": cmd_perf,
         "compare": cmd_compare,
         "trace": cmd_trace,

@@ -53,6 +53,31 @@ class TelemetryConfig(BaseModel):
     # node_exporter textfile collector or any scraper at it).
     PROMETHEUS_TEXTFILE: bool = Field(default=False)
 
+    # --- dispatch flight recorder (telemetry/flight.py) ---
+    # Intent-before / seal-after records for every hot-family device
+    # dispatch, appended crash-safely to runs/<run>/flight.jsonl so a
+    # SIGKILLed or wedged run still names the program it died inside
+    # (`cli doctor`). Two tiny appends per dispatch; perf-smoke pins
+    # the overhead under ~1% of iteration time.
+    FLIGHT_ENABLED: bool = Field(default=True)
+    FLIGHT_MAX_BYTES: int = Field(default=8 * 1024 * 1024, ge=0)
+    FLIGHT_KEEP_ROTATIONS: int = Field(default=1, ge=0)
+    # Per-dispatch deadline watchdog: a dispatch in flight past
+    # FACTOR x its expected duration (EWMA of this run's own sealed
+    # walls; MIN floors noisy fast programs) dumps stacks + trace,
+    # writes wedge_report.json, and exits WEDGE_EXIT_CODE (113) so the
+    # supervisor reclassifies the window in minutes. A program's FIRST
+    # dispatch includes its compile, hence the generous allowance.
+    DISPATCH_WATCHDOG_ENABLED: bool = Field(default=True)
+    DISPATCH_DEADLINE_FACTOR: float = Field(default=10.0, gt=1.0)
+    DISPATCH_MIN_DEADLINE_S: float = Field(default=60.0, gt=0)
+    DISPATCH_FIRST_DEADLINE_S: float = Field(default=900.0, gt=0)
+    DISPATCH_WATCHDOG_POLL_S: float = Field(default=5.0, gt=0)
+    # Exit-on-wedge is what turns a 10h silent window into a minutes-
+    # scale reclassification; tests and doctor-smoke disable it to
+    # observe the report without dying.
+    DISPATCH_EXIT_ON_WEDGE: bool = Field(default=True)
+
     # --- anomaly detection ---
     ANOMALY_ENABLED: bool = Field(default=True)
     ANOMALY_EWMA_ALPHA: float = Field(default=0.02, gt=0, le=1.0)

@@ -100,6 +100,7 @@ import numpy as np
 
 from ..compile_cache import config_digest, get_compile_cache
 from ..config.train_config import TrainConfig
+from ..telemetry.flight import flight_span
 from .device_buffer import DeviceReplayBuffer, ring_scatter
 
 logger = logging.getLogger(__name__)
@@ -198,6 +199,7 @@ class MegastepRunner:
             if self.sharded
             else (lambda t, k: f"megastep/t{t}_k{k}")
         )
+        self._name_fn = name
         self._megastep_fn = functools.lru_cache(maxsize=None)(
             lambda t, k: get_compile_cache().wrap(
                 name(t, k),
@@ -214,6 +216,9 @@ class MegastepRunner:
         # (telemetry/perf.py transfer accounting).
         self.dispatch_count = 0
         self.transfer_d2h_seconds = 0.0
+        # Flight recorder (telemetry/flight.py); training/setup.py and
+        # the loop's lazy construction path attach the run's recorder.
+        self.flight = None
 
     # --- device program ---------------------------------------------------
 
@@ -631,17 +636,23 @@ class MegastepRunner:
         max_p = self._max_priority_watermark()
         args = self._dispatch_args(t, k)
         start_step = trainer._host_step
-        (
-            trainer.state,
-            engine._carry,
-            buf.storage,
-            self._priorities,
-            out,
-        ) = self._megastep_fn(t, k)(*args)
-        self.dispatch_count += 1
-        t0 = time.perf_counter()
-        host = jax.device_get(out)  # the one transfer per megastep
-        self.transfer_d2h_seconds += time.perf_counter() - t0
+        with flight_span(
+            self.flight,
+            "megastep",
+            self._name_fn(t, k),
+            avals=f"B{self.batch_size}xT{t}xK{k}",
+        ):
+            (
+                trainer.state,
+                engine._carry,
+                buf.storage,
+                self._priorities,
+                out,
+            ) = self._megastep_fn(t, k)(*args)
+            self.dispatch_count += 1
+            t0 = time.perf_counter()
+            host = jax.device_get(out)  # the one transfer per megastep
+            self.transfer_d2h_seconds += time.perf_counter() - t0
 
         # --- host mirror reconciliation (megastep boundary) ----------
         if self.sharded:

@@ -53,6 +53,7 @@ from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
 from ..mcts.gumbel import GumbelMCTS
 from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
+from ..telemetry.flight import flight_span
 from ..mcts.search import BatchedMCTS
 from ..nn.network import NeuralNetwork
 from .types import SelfPlayResult
@@ -271,6 +272,9 @@ class SelfPlayEngine:
         # Rollout program dispatches (telemetry: the loop's dispatches-
         # per-iteration gauge; lock-guarded with the transfer time).
         self.dispatch_count = 0
+        # Dispatch flight recorder (telemetry/flight.py), attached by
+        # training/setup.py; None = no intent/seal records written.
+        self.flight = None
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
 
@@ -559,18 +563,27 @@ class SelfPlayEngine:
             if self._min_weights_version is None
             else min(self._min_weights_version, version)
         )
-        self._carry, outputs = self._chunk_fn(t)(
-            self._place_variables(self.net.variables, version),
-            self._carry,
-            jnp.int32(version),
-        )
-        payload: dict | None = None
-        t0 = time.perf_counter()
-        if fetch_experiences:
-            host = jax.device_get(outputs)  # the one transfer per chunk
-        else:
-            payload = {"mat": outputs.pop("mat"), "flush": outputs.pop("flush")}
-            host = jax.device_get(outputs)  # stats + trace only (small)
+        with flight_span(
+            self.flight,
+            "rollout",
+            f"self_play_chunk/t{t}",
+            avals=f"B{self.batch_size}xT{t}",
+        ):
+            self._carry, outputs = self._chunk_fn(t)(
+                self._place_variables(self.net.variables, version),
+                self._carry,
+                jnp.int32(version),
+            )
+            payload: dict | None = None
+            t0 = time.perf_counter()
+            if fetch_experiences:
+                host = jax.device_get(outputs)  # the one transfer per chunk
+            else:
+                payload = {
+                    "mat": outputs.pop("mat"),
+                    "flush": outputs.pop("flush"),
+                }
+                host = jax.device_get(outputs)  # stats + trace only (small)
         dt = time.perf_counter() - t0
         with self._transfer_lock:
             self.transfer_d2h_seconds += dt

@@ -34,6 +34,7 @@ from ..compile_cache import config_digest, get_compile_cache
 from ..config.mesh_config import MeshConfig
 from ..config.train_config import TrainConfig
 from ..nn.network import NeuralNetwork
+from ..telemetry.flight import flight_span
 from ..parallel.sharding import (
     batch_sharding,
     local_rows,
@@ -183,6 +184,9 @@ class Trainer:
         # Learner program dispatches (telemetry: the loop's dispatches-
         # per-iteration gauge; one per step/group dispatch).
         self.dispatch_count = 0
+        # Dispatch flight recorder (telemetry/flight.py), attached by
+        # training/setup.py; None = no intent/seal records written.
+        self.flight = None
         mc = nn.model_config
         self.num_atoms = mc.NUM_VALUE_ATOMS
         self.v_min, self.v_max = mc.VALUE_MIN, mc.VALUE_MAX
@@ -521,15 +525,18 @@ class Trainer:
         t0 = time.perf_counter()
         device_batch = shard_batch(self.mesh, batch, self.dp_axis)
         self.transfer_h2d_seconds += time.perf_counter() - t0
-        self.state, metrics, td = self._step_fn(self.state, device_batch)
-        self.dispatch_count += 1
-        # ONE blocking transfer for everything this step produced
-        # (fetching each metric separately costs a round trip apiece).
-        t0 = time.perf_counter()
-        host_metrics, td_host = jax.device_get(
-            (metrics, td if jax.process_count() == 1 else None)
-        )
-        self.transfer_d2h_seconds += time.perf_counter() - t0
+        with flight_span(
+            self.flight, "learner", "learner_step", avals=f"B{n}"
+        ):
+            self.state, metrics, td = self._step_fn(self.state, device_batch)
+            self.dispatch_count += 1
+            # ONE blocking transfer for everything this step produced
+            # (fetching each metric separately costs a round trip apiece).
+            t0 = time.perf_counter()
+            host_metrics, td_host = jax.device_get(
+                (metrics, td if jax.process_count() == 1 else None)
+            )
+            self.transfer_d2h_seconds += time.perf_counter() - t0
         if td_host is None:
             td_host = local_rows(td)
         self._host_step += 1
@@ -583,6 +590,11 @@ class Trainer:
             t0 = time.perf_counter()
             device_batch = shard_batch(self.mesh, batches[0], self.dp_axis)
             self.transfer_h2d_seconds += time.perf_counter() - t0
+            span = (
+                self.flight.begin("learner", "learner_step", avals=f"B{n}")
+                if self.flight is not None
+                else None
+            )
             self.state, metrics, td = self._step_fn(self.state, device_batch)
             self.dispatch_count += 1
             handle: dict = {"k": 1, "metrics": metrics, "td": td}
@@ -605,11 +617,23 @@ class Trainer:
                     stacked_host,
                 )
             self.transfer_h2d_seconds += time.perf_counter() - t0
+            span = (
+                self.flight.begin(
+                    "learner",
+                    "learner_fused_steps",
+                    avals=f"K{len(batches)}xB{n}",
+                )
+                if self.flight is not None
+                else None
+            )
             self.state, metrics_k, td_k = self._multi_step_fn(
                 self.state, stacked
             )
             self.dispatch_count += 1
             handle = {"k": len(batches), "metrics": metrics_k, "td": td_k}
+        # The group stays in flight until train_steps_finish fetches;
+        # the seal there gives the dispatch->fetch wall for the record.
+        handle["flight"] = span
         # The dispatch semantically runs the steps; advance the host
         # mirror now so LR lookups / buffer sampling for the NEXT group
         # see the post-group step while this group still executes.
@@ -647,10 +671,21 @@ class Trainer:
         weights = np.stack(
             [np.asarray(s["weights"], dtype=np.float32) for s in samples]
         )
+        sharded = getattr(buffer, "is_sharded", False)
         from_fn = (
-            self._get_from_sharded_fn(buffer)
-            if getattr(buffer, "is_sharded", False)
-            else self._from_fn
+            self._get_from_sharded_fn(buffer) if sharded else self._from_fn
+        )
+        program = (
+            "learner_fused_from_sharded_ring"
+            if sharded
+            else "learner_fused_from_ring"
+        )
+        span = (
+            self.flight.begin(
+                "learner", program, avals=f"K{len(samples)}"
+            )
+            if self.flight is not None
+            else None
         )
         self.state, metrics_k, td_k = from_fn(
             self.state, buffer.storage, idx, weights
@@ -662,6 +697,7 @@ class Trainer:
             "td": td_k,
             # The scan stacks outputs even at K=1; tells finish so.
             "stacked": True,
+            "flight": span,
             "start_step": self._host_step,
         }
         self._host_step += len(samples)
@@ -682,6 +718,9 @@ class Trainer:
             (metrics_k, td_k if jax.process_count() == 1 else None)
         )
         self.transfer_d2h_seconds += time.perf_counter() - t0
+        span = handle.pop("flight", None)
+        if span is not None:
+            span.seal()
         if td_host is None:
             td_host = local_rows(
                 td_k, axis=1 if (k > 1 or handle.get("stacked")) else 0

@@ -39,6 +39,7 @@ from collections import deque
 import numpy as np
 
 from ..mcts.helpers import select_root_actions
+from ..telemetry.flight import flight_span
 from .session import SessionSlots
 
 logger = logging.getLogger(__name__)
@@ -90,6 +91,9 @@ class PolicyService:
         self.mcts = mcts
         self.use_gumbel = bool(use_gumbel)
         self.telemetry = telemetry
+        # Flight recorder rides the run telemetry (telemetry/flight.py);
+        # None when serving without telemetry (tests, warm-only paths).
+        self.flight = getattr(telemetry, "flight", None)
         self._clock = clock
         self.sessions = SessionSlots(env, slots, pad_seed=pad_seed)
         # The serve program: the search jit wrapped for AOT executable
@@ -221,16 +225,23 @@ class PolicyService:
             t0 = self._clock()
             if rng is None:
                 rng = jax.random.fold_in(self._base_rng, self.dispatch_count)
-            out = self._search(
-                self.net.variables, self.sessions.states, rng
-            )
-            actions = select_root_actions(out, self.use_gumbel)
-            rewards, dones = self.sessions.step(actions, mask)
-            # Response materialization: the host sync IS the product
-            # here (clients need their move), one fetch per dispatch.
-            rewards_np = np.asarray(rewards)
-            dones_np = np.asarray(dones)
-            scores_np = np.asarray(self.sessions.states.score)
+            with flight_span(
+                self.flight,
+                "serve",
+                serve_program_name(self.sessions.slots),
+                avals=f"b{len(served)}",
+            ):
+                out = self._search(
+                    self.net.variables, self.sessions.states, rng
+                )
+                actions = select_root_actions(out, self.use_gumbel)
+                rewards, dones = self.sessions.step(actions, mask)
+                # Response materialization: the host sync IS the
+                # product here (clients need their move), one fetch
+                # per dispatch.
+                rewards_np = np.asarray(rewards)
+                dones_np = np.asarray(dones)
+                scores_np = np.asarray(self.sessions.states.score)
             t1 = self._clock()
 
             batch_ms = (t1 - t0) * 1e3

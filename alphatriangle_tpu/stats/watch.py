@@ -33,6 +33,11 @@ class WatchState:
     # Newest utilization record from the metrics ledger
     # (telemetry/perf.py): MFU, step time, transfer costs.
     util: dict = field(default_factory=dict)
+    # Newest flight-ring records (telemetry/flight.py): the last intent
+    # written and the last seal — together they say what the device is
+    # doing RIGHT NOW (or what it finished last).
+    flight_intent: dict = field(default_factory=dict)
+    flight_seal: dict = field(default_factory=dict)
     # (wall time, step, cumulative episodes) samples for rate windows.
     _samples: deque = field(default_factory=lambda: deque(maxlen=512))
 
@@ -70,6 +75,28 @@ class WatchState:
         if not isinstance(rec, dict) or rec.get("kind") != "util":
             return False
         self.util = rec
+        return True
+
+    def fold_flight_line(self, line: str) -> bool:
+        """Fold one flight-ring line (telemetry/flight.py schema);
+        keeps the newest intent and the newest seal. Returns False for
+        junk/torn/non-flight lines."""
+        line = line.strip()
+        if not line:
+            return False
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return False
+        if not isinstance(rec, dict) or rec.get("kind") != "flight":
+            return False
+        phase = rec.get("phase")
+        if phase == "intent":
+            self.flight_intent = rec
+        elif phase == "seal":
+            self.flight_seal = rec
+        else:
+            return False
         return True
 
     def _window(self) -> "tuple | None":
@@ -178,6 +205,48 @@ def memory_line(util: dict) -> "str | None":
     return line
 
 
+def last_dispatch_line(
+    state: WatchState, now: "float | None" = None
+) -> "str | None":
+    """Render the flight ring's freshest record as one line: the
+    program in flight right now (age vs expected/deadline — the wedge
+    early-warning), or the last sealed program's measured wall. None
+    when the run has no flight records (recorder off or pre-flight)."""
+    intent, seal = state.flight_intent, state.flight_seal
+    if not intent and not seal:
+        return None
+    now = time.time() if now is None else now
+    in_flight = bool(intent) and (
+        not seal or (intent.get("seq", -1) or 0) > (seal.get("seq", -1) or 0)
+    )
+    if in_flight:
+        t = intent.get("time")
+        age = max(0.0, now - float(t)) if isinstance(t, (int, float)) else None
+        expected = intent.get("expected_s")
+        deadline = intent.get("deadline_s")
+        line = (
+            f"  dispatch     {intent.get('program')} "
+            f"[{intent.get('family')}] in flight"
+            f" {_fmt(age, ',.0f', 's')}"
+        )
+        if isinstance(expected, (int, float)):
+            line += f"   expected {expected:,.1f}s"
+        if isinstance(deadline, (int, float)):
+            line += f"   deadline {deadline:,.0f}s"
+            if age is not None and age > deadline:
+                line += "  — OVER DEADLINE"
+        return line
+    t = seal.get("time")
+    age = max(0.0, now - float(t)) if isinstance(t, (int, float)) else None
+    ok = seal.get("ok", True)
+    return (
+        f"  dispatch     {seal.get('program')} [{seal.get('family')}]"
+        f" sealed {_fmt(age, ',.0f', 's')} ago"
+        f"   wall {_fmt(seal.get('wall_s'), ',.2f', 's')}"
+        + ("" if ok else "  — ERROR")
+    )
+
+
 def render_frame(
     state: WatchState, run_name: str, health: "dict | None" = None
 ) -> str:
@@ -221,6 +290,9 @@ def render_frame(
         mline = memory_line(u)
         if mline is not None:
             lines.append(mline)
+    dline = last_dispatch_line(state)
+    if dline is not None:
+        lines.append(dline)
     hline = health_line(health)
     if hline is not None:
         lines.append(hline)
@@ -273,6 +345,15 @@ def tail_ledger_utils(
 ) -> int:
     """Fold `metrics.jsonl` utilization records appended past `offset`."""
     return tail_jsonl(path, state.fold_util_line, offset)
+
+
+def tail_flight(
+    path: Path,
+    state: WatchState,
+    offset: int = 0,
+) -> int:
+    """Fold `flight.jsonl` dispatch records appended past `offset`."""
+    return tail_jsonl(path, state.fold_flight_line, offset)
 
 
 def find_latest_run_dir(runs_root: Path) -> "Path | None":

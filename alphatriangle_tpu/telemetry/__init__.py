@@ -42,6 +42,17 @@ from .health import (
     health_verdict,
     read_health,
 )
+from .flight import (
+    FLIGHT_FILENAME,
+    WEDGE_EXIT_CODE,
+    WEDGE_REPORT_FILENAME,
+    DispatchWatchdog,
+    FlightRecorder,
+    classify_run,
+    flight_span,
+    read_flight,
+    summarize_flight,
+)
 from .ledger import (
     METRICS_FILENAME,
     PROM_FILENAME,
@@ -70,6 +81,8 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "Anomaly",
     "AnomalyDetector",
+    "DispatchWatchdog",
+    "FlightRecorder",
     "HealthMonitor",
     "MetricsLedger",
     "RunTelemetry",
@@ -78,6 +91,10 @@ __all__ = [
     "UtilizationMeter",
     "Watchdog",
     "attribution_rows",
+    "classify_run",
+    "flight_span",
+    "read_flight",
+    "summarize_flight",
     "compose_budget",
     "dump_thread_stacks",
     "estimate_fit",
@@ -168,6 +185,30 @@ class RunTelemetry:
                 on_stall=self._on_stall,
                 clock=clock,
             )
+        # Dispatch flight recorder + per-dispatch deadline watchdog
+        # (telemetry/flight.py): the black box that survives a dead
+        # process. Components pick the recorder up as a `flight`
+        # attribute (training/setup.py, serving/service.py).
+        self.flight: FlightRecorder | None = None
+        self.dispatch_watchdog: DispatchWatchdog | None = None
+        if enabled and self.config.FLIGHT_ENABLED:
+            if self.config.DISPATCH_WATCHDOG_ENABLED:
+                self.dispatch_watchdog = DispatchWatchdog(
+                    self.run_dir,
+                    poll_s=self.config.DISPATCH_WATCHDOG_POLL_S,
+                    on_wedge=self._on_wedge,
+                    exit_on_wedge=self.config.DISPATCH_EXIT_ON_WEDGE,
+                    clock=clock,
+                )
+            self.flight = FlightRecorder(
+                self.run_dir / FLIGHT_FILENAME,
+                max_bytes=self.config.FLIGHT_MAX_BYTES,
+                keep=self.config.FLIGHT_KEEP_ROTATIONS,
+                deadline_factor=self.config.DISPATCH_DEADLINE_FACTOR,
+                min_deadline_s=self.config.DISPATCH_MIN_DEADLINE_S,
+                first_deadline_s=self.config.DISPATCH_FIRST_DEADLINE_S,
+                watchdog=self.dispatch_watchdog,
+            )
         self._step = 0
         self._memory_seen: set = set()
         self._last_write_mono = None
@@ -184,6 +225,8 @@ class RunTelemetry:
     def start(self) -> None:
         if self.watchdog is not None:
             self.watchdog.start()
+        if self.dispatch_watchdog is not None:
+            self.dispatch_watchdog.start()
 
     def close(self, step: int | None = None) -> None:
         """Stop the watchdog, write the final heartbeat + trace export."""
@@ -192,6 +235,10 @@ class RunTelemetry:
         self._closed = True
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.dispatch_watchdog is not None:
+            self.dispatch_watchdog.stop()
+        if self.flight is not None:
+            self.flight.close()
         if not self.enabled:
             return
         if step is not None:
@@ -359,3 +406,18 @@ class RunTelemetry:
             self.run_dir / STACKS_FILENAME,
             self.run_dir / TRACE_FILENAME,
         )
+
+    def _on_wedge(self, info: dict) -> None:
+        """Dispatch-watchdog hook (runs BEFORE wedge_report.json lands
+        and any exit): the timeline INTO the wedge must be on disk."""
+        self.tracer.instant(
+            "dispatch_wedge",
+            program=info.get("program"),
+            elapsed_s=info.get("elapsed_s"),
+        )
+        if self.config.FLUSH_TRACE_ON_STALL:
+            self.tracer.export(self.run_dir / TRACE_FILENAME)
+        # No heartbeat write here: `health.write` snapshots device
+        # memory, and touching a wedged device could hang the watchdog
+        # thread before the wedge report lands.
+        self.health.set_stalled(True)

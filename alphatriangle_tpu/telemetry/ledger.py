@@ -160,26 +160,35 @@ def ledger_paths(path: Path | str) -> list[Path]:
     return out
 
 
+def iter_jsonl_records(path: Path | str, kinds: "set[str] | None" = None):
+    """Yield parsed dict records from ONE JSONL file, skipping torn/junk
+    lines. The single tolerant reader under every crash-safe artifact
+    here: the metrics ledger walks it per rotation, and the dispatch
+    flight ring (telemetry/flight.py) reads through it instead of
+    duplicating the torn-tail handling."""
+    try:
+        with Path(path).open("r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn write / junk byte: skip, never raise
+                if not isinstance(rec, dict):
+                    continue
+                if kinds is not None and rec.get("kind") not in kinds:
+                    continue
+                yield rec
+    except OSError:
+        return
+
+
 def iter_ledger_records(path: Path | str, kinds: "set[str] | None" = None):
     """Yield parsed records across rotations, skipping torn/junk lines."""
     for p in ledger_paths(path):
-        try:
-            with p.open("r", errors="replace") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue  # torn write / junk byte: skip, never raise
-                    if not isinstance(rec, dict):
-                        continue
-                    if kinds is not None and rec.get("kind") not in kinds:
-                        continue
-                    yield rec
-        except OSError:
-            continue
+        yield from iter_jsonl_records(p, kinds=kinds)
 
 
 def read_ledger(path: Path | str, kinds: "set[str] | None" = None) -> list[dict]:

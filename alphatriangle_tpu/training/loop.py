@@ -113,6 +113,12 @@ class TrainingLoop:
             run_name=components.persistence_config.RUN_NAME,
         )
         components.telemetry = self.telemetry
+        # Manually assembled components (tests, bench harnesses) skip
+        # training/setup.py's flight attach; wire the recorder here so
+        # every construction path records dispatches.
+        for c in (components.self_play, components.trainer):
+            if c is not None and getattr(c, "flight", None) is None:
+                c.flight = self.telemetry.flight
         # Per-phase timers always run (ns-level overhead); the device
         # trace + metric export + json dump activate under --profile
         # (reference `worker.py:99-104`, TrainConfig.PROFILE_WORKERS).
@@ -658,6 +664,7 @@ class TrainingLoop:
             runner = MegastepRunner(
                 self.c.self_play, self.c.trainer, self.c.buffer, cfg
             )
+            runner.flight = getattr(self.telemetry, "flight", None)
             self.c.megastep = self._megastep_runner = runner
         need = max(cfg.MIN_BUFFER_SIZE_TO_TRAIN, cfg.BATCH_SIZE)
         iteration = 0

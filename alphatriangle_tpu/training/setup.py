@@ -389,6 +389,14 @@ def setup_training_components(
     from ..compile_cache import get_compile_cache
 
     get_compile_cache().set_tracer(telemetry.tracer)
+    # Dispatch flight recorder (telemetry/flight.py): every hot-family
+    # device dispatch writes an intent record before launch and a seal
+    # after the fetch, so a SIGKILLed window still names the program it
+    # died inside (`cli doctor`).
+    self_play.flight = telemetry.flight
+    trainer.flight = telemetry.flight
+    if megastep_runner is not None:
+        megastep_runner.flight = telemetry.flight
     # Static memory attribution -> metrics ledger (telemetry/memory.py):
     # train-state bytes from tree-size accounting, replay-ring bytes
     # from the buffers' own dtype/shape math. Program records join

@@ -283,6 +283,63 @@ def main() -> int:
         f"{len(mem_utils)} util record(s) with live accounting"
     )
 
+    print("perf-smoke: flight recorder gate...", flush=True)
+    # The dispatch flight recorder (telemetry/flight.py) must have
+    # sealed real dispatches from this run's hot sites — an empty ring
+    # means the instrumentation came unwired — and its measured
+    # bookkeeping overhead must stay under ~1% of the run's wall time
+    # (compared against total wall, not sealed dispatch wall: tiny CPU
+    # dispatches make that ratio meaningless).
+    from alphatriangle_tpu.telemetry.flight import read_flight
+    from alphatriangle_tpu.telemetry.ledger import iter_jsonl_records
+
+    flight_path = pc.get_run_base_dir() / "flight.jsonl"
+    flight = read_flight(flight_path)
+    seals = [r for r in flight if r.get("phase") == "seal" and r.get("ok")]
+    families = {r.get("family") for r in seals}
+    if not seals or not {"rollout", "learner"} <= families:
+        print(
+            f"perf-smoke: {flight_path} holds {len(seals)} sealed "
+            f"dispatch(es) across families {sorted(families)} — the "
+            "flight recorder came unwired from the hot dispatch sites",
+            file=sys.stderr,
+        )
+        return 2
+    run_wall = sum(
+        r["window_s"]
+        for r in records
+        if r.get("kind") == "util"
+        and isinstance(r.get("window_s"), (int, float))
+    )
+    overhead = next(
+        (
+            r.get("overhead_s")
+            for r in reversed(list(iter_jsonl_records(flight_path)))
+            if r.get("kind") == "flight_overhead"
+        ),
+        None,
+    )
+    if not isinstance(overhead, (int, float)):
+        print(
+            f"perf-smoke: {flight_path} has no flight_overhead summary "
+            "record (FlightRecorder.close never ran?)",
+            file=sys.stderr,
+        )
+        return 2
+    if run_wall > 0 and overhead > 0.01 * run_wall:
+        print(
+            f"perf-smoke: flight overhead {overhead:.3f}s exceeds 1% of "
+            f"the run's {run_wall:.1f}s wall — the recorder is on the "
+            "hot path",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"perf-smoke: {len(seals)} sealed dispatch(es) "
+        f"({', '.join(sorted(f for f in families if f))}); overhead "
+        f"{overhead:.4f}s of {run_wall:.1f}s wall"
+    )
+
     print("perf-smoke: cli perf (schema gate)...", flush=True)
     rc = cli_main(["perf", RUN_NAME, "--root-dir", root])
     if rc != 0:

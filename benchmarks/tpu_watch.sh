@@ -6,6 +6,16 @@
 # minutes-to-hours (observed across rounds 2-4), so unattended
 # persistence is the only way to land a full sweep.
 #
+# Every window ends in forensics: on an aborted command or a failed
+# probe the newest run's flight ring + heartbeat + wedge report +
+# trace are archived under runs/_windows/<ts>/ and `cli doctor`
+# (JAX-free — safe beside the wedged chip) classifies how the window
+# died, appending one verdict line per window to runs/_windows/
+# windows.jsonl. A command exiting with the dispatch watchdog's code
+# (113) is a detected wedge, not a crash: the watchdog already wrote
+# wedge_report.json and the window is reclassified in minutes instead
+# of being silently eaten (docs/OBSERVABILITY.md "Flight recorder").
+#
 #   WATCH_BUDGET_S  total wall budget (default 6h)
 #   WATCH_CMD       command to run in a healthy window
 #                   (default: bash benchmarks/tpu_round4.sh)
@@ -23,6 +33,33 @@ deadline=$(( $(date +%s) + ${WATCH_BUDGET_S:-21600} ))
 cmd=${WATCH_CMD:-"bash benchmarks/tpu_round4.sh"}
 warm_s=${WATCH_WARM_S:-900}
 tune_s=${WATCH_TUNE_S:-600}
+runs_root=.alphatriangle_data/AlphaTriangleTPU/runs
+
+# Archive the newest run's postmortem artifacts and record a doctor
+# verdict for this window. $1 labels why the window ended (probe-failed
+# / cmd-aborted / cmd-wedged). Best-effort throughout: forensics must
+# never take down the watcher.
+archive_window() {
+  local why=$1 ts run_dir dest verdict rc
+  ts=$(date +%Y%m%d_%H%M%S)
+  run_dir=$(ls -1dt "$runs_root"/*/ 2>/dev/null | grep -v "_windows" | head -1)
+  [ -n "$run_dir" ] || return 0
+  dest="$runs_root/_windows/$ts"
+  mkdir -p "$dest"
+  for f in flight.jsonl flight.jsonl.1 health.json wedge_report.json \
+           wedge_stacks.txt stall_stacks.txt trace.json; do
+    [ -f "$run_dir/$f" ] && cp "$run_dir/$f" "$dest/" 2>/dev/null
+  done
+  # JAX-free postmortem: names the program the window died inside.
+  verdict=$(timeout 60 python -m alphatriangle_tpu.cli doctor "$run_dir" --json 2>/dev/null)
+  rc=$?
+  [ -n "$verdict" ] || verdict='{"verdict": "unreadable", "exit_code": null}'
+  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "doctor": %s}\n' \
+    "$ts" "$why" "$run_dir" "$verdict" >> "$runs_root/_windows/windows.jsonl"
+  echo "$verdict" > "$dest/doctor.json"
+  echo "$(date +%T) window archived: $dest ($why, doctor rc=$rc)" >&2
+}
+
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     # Probe passed: warm the compile caches (XLA persistent + AOT
@@ -46,7 +83,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # should use, so pre-warm its shapes while the chip is healthy.
     # Best-effort like the warm: never blocks the sweep attempt.
     if [ "$tune_s" -gt 0 ]; then
-      tuned=.alphatriangle_data/AlphaTriangleTPU/runs/tune_auto/tuned_preset.json
+      tuned=$runs_root/tune_auto/tuned_preset.json
       echo "$(date +%T) chip healthy; autotuning (<=${tune_s}s)" >&2
       if timeout "$tune_s" python -m alphatriangle_tpu.cli tune auto \
            --run-name tune_auto >&2 && [ -f "$tuned" ]; then
@@ -57,13 +94,25 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       fi
     fi
     echo "$(date +%T) chip healthy; running: $cmd" >&2
-    if eval "$cmd"; then
+    eval "$cmd"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
       echo "$(date +%T) command complete" >&2
       exit 0
     fi
-    echo "$(date +%T) command aborted (wedge?); back to probing" >&2
+    if [ "$rc" -eq 113 ]; then
+      # The dispatch watchdog detected an over-deadline dispatch,
+      # dumped stacks + wedge_report.json and exited: a DETECTED
+      # wedge, reclassified here instead of lost to a silent hang.
+      echo "$(date +%T) command wedged (dispatch watchdog, exit 113); back to probing" >&2
+      archive_window "cmd-wedged"
+    else
+      echo "$(date +%T) command aborted (rc=$rc); back to probing" >&2
+      archive_window "cmd-aborted"
+    fi
   else
     echo "$(date +%T) probe failed (chip wedged)" >&2
+    archive_window "probe-failed"
   fi
   sleep 120
 done
