@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke
 
 check: lint type test
 
@@ -75,6 +75,15 @@ serve-smoke:
 # that path.
 doctor-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/doctor_smoke.py
+
+# Kernel-library gate (docs/KERNELS.md): every interchangeable lowering
+# in alphatriangle_tpu/ops/ (gather_rows, backup_update, per_sample)
+# must match its reference backend bit-for-bit across a shape grid
+# before it is timed; a parity break fails the target. CPU runs the
+# Pallas rows in interpret mode — set OPS_BENCH_FULL=1 on a TPU host
+# for decision-grade timings at flagship shapes.
+ops-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/ops_bench.py
 
 # Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
 # under a host-RAM byte limit must emit a tuned_preset.json that

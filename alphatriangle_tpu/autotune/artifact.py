@@ -70,6 +70,12 @@ def build_tuned_preset(
             "fused_k": cand.fused_k,
             "dp": cand.dp,
         },
+        # Kernel-axis provenance (docs/KERNELS.md): which lowering of
+        # each hot kernel and which rollout inference precision the
+        # winner was scored with. The same values are threaded into
+        # the config bundle below, so `--preset` runs reproduce them;
+        # this block keeps them auditable without config spelunking.
+        "kernels": cand.kernels(),
         "configs": {
             "env": env_config.model_dump(),
             "model": model_config.model_dump(),

@@ -85,12 +85,16 @@ def materialize_candidate(candidate, base_env, base_model, base_train, mode):
                 )
             }
         )
+    model = model.model_copy(
+        update={"INFERENCE_PRECISION": candidate.inference_precision}
+    )
     kw = base_train.model_dump()
     kw.update(
         SELF_PLAY_BATCH_SIZE=candidate.sp_batch,
         BUFFER_CAPACITY=candidate.capacity,
         ROLLOUT_CHUNK_MOVES=candidate.chunk,
         FUSED_LEARNER_STEPS=candidate.fused_k,
+        PER_SAMPLE_BACKEND=candidate.per_sample,
         MIN_BUFFER_SIZE_TO_TRAIN=min(
             base_train.MIN_BUFFER_SIZE_TO_TRAIN, candidate.capacity
         ),
@@ -101,6 +105,17 @@ def materialize_candidate(candidate, base_env, base_model, base_train, mode):
         )
     train = TrainConfig(**kw)
     return env, model, train
+
+
+def candidate_mcts(base_mcts, candidate):
+    """The MCTS config a candidate's programs lower with: the base
+    search config carrying the candidate's kernel axes."""
+    return base_mcts.model_copy(
+        update={
+            "descent_gather": candidate.descent_gather,
+            "backup_update": candidate.backup_update,
+        }
+    )
 
 
 def ring_bytes_for(candidate, env, model) -> int:
@@ -140,7 +155,7 @@ def default_oracle(mcts_config, mode, device_replay=None, progress=None):
         report = estimate_fit(
             env,
             model,
-            mcts_config,
+            candidate_mcts(mcts_config, candidate),
             train,
             fused_k=candidate.fused_k,
             device_replay=ring_on_device,
@@ -198,6 +213,7 @@ def run_search(
             "chunk": candidate.chunk,
             "fused_k": candidate.fused_k,
             "dp": candidate.dp,
+            "kernels": candidate.kernels(),
             "status": status,
             "detail": detail,
             "predicted": prediction,
@@ -253,7 +269,11 @@ def run_search(
 
     # Evaluate every group's frontier (B descending): the first
     # oracle-confirmed B wins the group; smaller Bs are dominated.
+    # Candidates sharing an oracle_key — differing only on the
+    # memory-neutral kernel axes (autotune/space.py) — reuse one
+    # oracle answer, so those axes multiply the lattice for free.
     best = None
+    oracle_memo: dict = {}
     for _key, frontier in group_frontiers:
         winner = None
         for cand, env, model, train, prediction in frontier:
@@ -265,9 +285,14 @@ def run_search(
                     detail=f"B{winner.sp_batch} fits in this group",
                 )
                 continue
-            result.oracle_calls += 1
-            say(f"tune: oracle {cand.label()} ...")
-            fits, budget, records = oracle(cand, env, model, train, limit_bytes)
+            memo_key = cand.oracle_key()
+            cached = oracle_memo.get(memo_key)
+            if cached is None:
+                result.oracle_calls += 1
+                say(f"tune: oracle {cand.label()} ...")
+                cached = oracle(cand, env, model, train, limit_bytes)
+                oracle_memo[memo_key] = cached
+            fits, budget, records = cached
             result.evaluated += 1
             if fits:
                 winner = cand

@@ -36,7 +36,15 @@ STATUS_SKIPPED = "skipped"  # search ended before evaluation
 
 @dataclass(frozen=True)
 class Candidate:
-    """One point in the autotuner's search space."""
+    """One point in the autotuner's search space.
+
+    The kernel axes (docs/KERNELS.md) select interchangeable lowerings
+    of the hot kernels plus the rollout inference precision. They are
+    parity-pinned rewrites of the same math, so they never change WHAT
+    a run computes — only how fast — and all but two are memory-free:
+    `descent_gather="einsum"` adds a one-hot transient and
+    `inference_precision="bfloat16"` a cast parameter copy, which is
+    why exactly those two appear in `oracle_key()`."""
 
     geometry: str  # named board geometry (config/presets.py)
     sp_batch: int  # SELF_PLAY_BATCH_SIZE (lockstep lanes)
@@ -44,16 +52,66 @@ class Candidate:
     chunk: int  # ROLLOUT_CHUNK_MOVES (T)
     fused_k: int  # FUSED_LEARNER_STEPS (K)
     dp: int  # data-parallel mesh width tuned for
+    descent_gather: str = "einsum"  # MCTSConfig.descent_gather
+    backup_update: str = "xla"  # MCTSConfig.backup_update
+    per_sample: str = "xla"  # TrainConfig.PER_SAMPLE_BACKEND
+    inference_precision: str = "float32"  # ModelConfig.INFERENCE_PRECISION
 
     def group_key(self) -> tuple:
         """Axes held fixed under monotone-in-B dominance."""
-        return (self.geometry, self.capacity, self.chunk, self.fused_k, self.dp)
+        return (
+            self.geometry,
+            self.capacity,
+            self.chunk,
+            self.fused_k,
+            self.dp,
+            self.descent_gather,
+            self.backup_update,
+            self.per_sample,
+            self.inference_precision,
+        )
+
+    def oracle_key(self) -> tuple:
+        """Axes the feasibility oracle's answer can depend on. Kernel
+        axes that only reorder the same buffer traffic (backup_update,
+        per_sample) are deliberately absent: candidates differing only
+        there share one oracle result (a free axis for the search)."""
+        return (
+            self.geometry,
+            self.sp_batch,
+            self.capacity,
+            self.chunk,
+            self.fused_k,
+            self.dp,
+            self.descent_gather,
+            self.inference_precision,
+        )
+
+    def kernels(self) -> dict:
+        """The kernel-axis block (tuned_preset.json provenance)."""
+        return {
+            "descent_gather": self.descent_gather,
+            "backup_update": self.backup_update,
+            "per_sample": self.per_sample,
+            "inference_precision": self.inference_precision,
+        }
 
     def label(self) -> str:
-        return (
+        base = (
             f"{self.geometry}/B{self.sp_batch}/cap{self.capacity}"
             f"/t{self.chunk}/k{self.fused_k}/dp{self.dp}"
         )
+        tags = [
+            tag
+            for tag, default in (
+                (f"g-{self.descent_gather}", "g-einsum"),
+                (f"b-{self.backup_update}", "b-xla"),
+                (f"s-{self.per_sample}", "s-xla"),
+                (f"p-{self.inference_precision}", "p-float32"),
+            )
+            if tag != default
+        ]
+        return base + (f"/{'+'.join(tags)}" if tags else "")
 
 
 @dataclass
@@ -68,29 +126,49 @@ class SearchSpace:
     chunks: list = field(default_factory=lambda: [8, 16])
     fused_ks: list = field(default_factory=lambda: [8, 16])
     dps: list = field(default_factory=lambda: [1])
+    # Kernel axes (docs/KERNELS.md). Single-valued by default, so the
+    # lattice only grows when a caller opts into the comparison; axes
+    # sharing an oracle_key reuse the same feasibility answer.
+    descent_gathers: list = field(default_factory=lambda: ["einsum"])
+    backup_updates: list = field(default_factory=lambda: ["xla"])
+    per_samples: list = field(default_factory=lambda: ["xla"])
+    precisions: list = field(default_factory=lambda: ["float32"])
 
     def candidates(self) -> list:
         """Every lattice point, B descending within each group so the
         dominance walk can early-exit on the first feasible lane count."""
+        kernel_points = [
+            (g, bu, ps, pr)
+            for g in self.descent_gathers
+            for bu in self.backup_updates
+            for ps in self.per_samples
+            for pr in self.precisions
+        ]
         out = []
         for geometry in self.geometries:
             for capacity in sorted({int(c) for c in self.capacities}):
                 for chunk in sorted({int(t) for t in self.chunks}):
                     for k in sorted({int(k) for k in self.fused_ks}):
                         for dp in sorted({int(d) for d in self.dps}):
-                            for b in sorted(
-                                {int(b) for b in self.batches}, reverse=True
-                            ):
-                                out.append(
-                                    Candidate(
-                                        geometry=geometry,
-                                        sp_batch=b,
-                                        capacity=capacity,
-                                        chunk=chunk,
-                                        fused_k=k,
-                                        dp=dp,
+                            for gather, backup, sample, prec in kernel_points:
+                                for b in sorted(
+                                    {int(b) for b in self.batches},
+                                    reverse=True,
+                                ):
+                                    out.append(
+                                        Candidate(
+                                            geometry=geometry,
+                                            sp_batch=b,
+                                            capacity=capacity,
+                                            chunk=chunk,
+                                            fused_k=k,
+                                            dp=dp,
+                                            descent_gather=gather,
+                                            backup_update=backup,
+                                            per_sample=sample,
+                                            inference_precision=prec,
+                                        )
                                     )
-                                )
         return out
 
     def size(self) -> int:
@@ -101,6 +179,10 @@ class SearchSpace:
             * len({int(t) for t in self.chunks})
             * len({int(k) for k in self.fused_ks})
             * len({int(d) for d in self.dps})
+            * len(self.descent_gathers)
+            * len(self.backup_updates)
+            * len(self.per_samples)
+            * len(self.precisions)
         )
 
 

@@ -6,8 +6,9 @@ warmer lowers PRECISELY the programs the bench will dispatch — same
 configs, same batch/chunk/K shapes, same dtypes. Duplicating the bench's
 config-building logic in the warm path would drift; both now call
 `resolve_bench_plan`, which honors the same env knobs (BENCH_CONFIG,
-BENCH_RECIPE, BENCH_GATHER, BENCH_WAVE, BENCH_FAST_SIMS,
-BENCH_FULL_PROB, BENCH_BATCH) and the same cpu/smoke clamps.
+BENCH_RECIPE, BENCH_GATHER, BENCH_BACKUP, BENCH_PER_SAMPLE,
+BENCH_PRECISION, BENCH_WAVE, BENCH_FAST_SIMS, BENCH_FULL_PROB,
+BENCH_BATCH) and the same cpu/smoke clamps.
 """
 
 import os
@@ -122,7 +123,8 @@ def resolve_bench_plan(
         # Honor the A/B knobs in the preset path too (a silently
         # ignored knob would mislabel the measurement).
         preset_mcts_updates: dict = {
-            "descent_gather": env.get("BENCH_GATHER", "einsum")
+            "descent_gather": env.get("BENCH_GATHER", "einsum"),
+            "backup_update": env.get("BENCH_BACKUP", "xla"),
         }
         if env.get("BENCH_WAVE"):
             preset_mcts_updates["mcts_batch_size"] = int(env["BENCH_WAVE"])
@@ -179,6 +181,18 @@ def resolve_bench_plan(
             model_cfg = model_cfg.model_copy(
                 update={"COMPUTE_DTYPE": "float32"}
             )
+        # Rollout/serve inference precision A/B (nn/precision.py,
+        # docs/KERNELS.md); the learner keeps consuming f32 params.
+        model_cfg = model_cfg.model_copy(
+            update={
+                "INFERENCE_PRECISION": env.get(
+                    "BENCH_PRECISION", "float32"
+                )
+            }
+        )
+        train_updates["PER_SAMPLE_BACKEND"] = env.get(
+            "BENCH_PER_SAMPLE", "xla"
+        )
         # Rebuild via the constructor so validation + schedule-length
         # derivation run against the bench horizon.
         base_kw = bundle["train"].model_dump()
@@ -213,6 +227,9 @@ def resolve_bench_plan(
         model_cfg = ModelConfig(
             OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
             COMPUTE_DTYPE="float32" if backend == "cpu" else "bfloat16",
+            # Rollout/serve inference precision A/B (nn/precision.py,
+            # docs/KERNELS.md); the learner keeps f32 params.
+            INFERENCE_PRECISION=env.get("BENCH_PRECISION", "float32"),
         )
         mcts_kw: dict = {}
         if env.get("BENCH_FAST_SIMS"):
@@ -256,9 +273,10 @@ def resolve_bench_plan(
         mcts_cfg = AlphaTriangleMCTSConfig(
             max_simulations=sims,
             max_depth=depth,
-            # A/B knob for the descent row-gather lowering
-            # (ops/gather_rows.py).
+            # A/B knobs for the descent row-gather and fused-backup
+            # lowerings (ops/gather_rows.py, ops/mcts_backup.py).
             descent_gather=env.get("BENCH_GATHER", "einsum"),
+            backup_update=env.get("BENCH_BACKUP", "xla"),
             **mcts_kw,
         )
         train_cfg = TrainConfig(
@@ -268,6 +286,7 @@ def resolve_bench_plan(
             BUFFER_CAPACITY=10_000,
             MIN_BUFFER_SIZE_TO_TRAIN=1_000,
             MAX_TRAINING_STEPS=1_000,
+            PER_SAMPLE_BACKEND=env.get("BENCH_PER_SAMPLE", "xla"),
             RUN_NAME="bench",
         )
         description = f"{scale} scale"

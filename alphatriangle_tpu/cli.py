@@ -1907,6 +1907,16 @@ def cmd_tune(args: argparse.Namespace) -> int:
     geometries = (
         args.geometries.split(",") if args.geometries else ["plan"]
     )
+    kernel_backends = (
+        args.kernel_backends.split(",")
+        if getattr(args, "kernel_backends", None)
+        else ["xla"]
+    )
+    precisions = (
+        args.precisions.split(",")
+        if getattr(args, "precisions", None)
+        else ["float32"]
+    )
     space = SearchSpace(
         geometries=geometries,
         batches=batches,
@@ -1914,6 +1924,9 @@ def cmd_tune(args: argparse.Namespace) -> int:
         chunks=chunks,
         fused_ks=fused_ks,
         dps=dps,
+        backup_updates=kernel_backends,
+        per_samples=kernel_backends,
+        precisions=precisions,
     )
 
     calibration = calibration_from_targets(
@@ -1947,7 +1960,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
     payload = None
     out_path = None
     if result.best is not None:
-        from .autotune.search import materialize_candidate
+        from .autotune.search import candidate_mcts, materialize_candidate
 
         env_cfg, model_cfg, train_cfg = materialize_candidate(
             result.best, plan.env, plan.model, plan.train, mode
@@ -1957,7 +1970,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
             result,
             env_cfg,
             model_cfg,
-            plan.mcts,
+            candidate_mcts(plan.mcts, result.best),
             train_cfg,
             scale=plan.scale,
             mode=mode,
@@ -2482,6 +2495,22 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="Board geometry presets to search (comma-separated names "
         "from config.GEOMETRY_PRESETS, or 'plan' = the scale's board).",
+    )
+    tune.add_argument(
+        "--kernel-backends",
+        default=None,
+        metavar="BACKENDS",
+        help="Kernel lowerings to search for backup_update and "
+        "PER_SAMPLE_BACKEND (comma-separated from xla,pallas — "
+        "docs/KERNELS.md). Free axes: memory-neutral variants share "
+        "oracle results. Default: xla only.",
+    )
+    tune.add_argument(
+        "--precisions",
+        default=None,
+        metavar="DTYPES",
+        help="INFERENCE_PRECISION values to search (comma-separated "
+        "from float32,bfloat16). Default: float32 only.",
     )
     tune.add_argument(
         "--calibrate",

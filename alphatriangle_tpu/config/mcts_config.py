@@ -41,6 +41,11 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # or "take" (XLA native gather). Numerically identical; a pure
     # performance knob to be settled by on-hardware benchmarks.
     descent_gather: str = Field(default="einsum", pattern="^(einsum|pallas|take)$")
+    # How the wave's insertion + discounted backup writes the edge
+    # planes: "xla" (the original scatter chain) or "pallas" (one fused
+    # per-game VMEM kernel, ops/mcts_backup.py). Parity-pinned; a pure
+    # performance knob to be settled by on-hardware benchmarks.
+    backup_update: str = Field(default="xla", pattern="^(xla|pallas)$")
     # --- Playout cap randomization (KataGo, arXiv:1902.10565 §3.1;
     # PAPERS.md) — beyond-reference acceleration, off by default. When
     # `fast_simulations` is set, each lockstep move runs the full

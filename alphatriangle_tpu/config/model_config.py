@@ -60,6 +60,12 @@ class ModelConfig(BaseModel):
     PARAM_DTYPE: Literal["float32"] = Field(default="float32")
     # jax.checkpoint the residual + transformer blocks to trade FLOPs for HBM.
     REMAT: bool = Field(default=False)
+    # Param dtype the INFERENCE family (rollout chunk, serve dispatch,
+    # arena/eval) reads the network at; the learner family always
+    # trains the f32 originals (nn/precision.py, docs/KERNELS.md).
+    INFERENCE_PRECISION: Literal["float32", "bfloat16"] = Field(
+        default="float32"
+    )
 
     @property
     def USE_BATCH_NORM(self) -> bool:

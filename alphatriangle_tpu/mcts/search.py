@@ -54,7 +54,7 @@ from flax import struct
 from ..config.mcts_config import MCTSConfig
 from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
-from ..ops import gather_rows
+from ..ops import backup_update, gather_rows
 
 
 @struct.dataclass
@@ -389,17 +389,14 @@ class BatchedMCTS:
                 tree.valid, valid.reshape(batch, w, a).astype(jnp.float32)
             ),
             terminal=insert(tree.terminal, dones),
-            children=tree.children.at[bcol, parents, actions].max(
-                jnp.where(is_new, slot_ids, -1.0)
-            ),
-            e_reward=tree.e_reward.at[bcol, parents, actions].set(rewards),
         )
 
-        # 5. Backup along the recorded paths. Suffix returns first:
-        # G_d = r_d + discount * G_{d+1}, where the deepest active
-        # level's reward is the fresh step reward (a new edge has no
-        # stored reward yet; for revisits the stored value is identical
-        # by determinism).
+        # 5. Insertion + backup along the recorded paths as one fused
+        # edge-plane update (ops/mcts_backup.py; lowering per config).
+        # Suffix returns first: G_d = r_d + discount * G_{d+1}, where
+        # the deepest active level's reward is the fresh step reward (a
+        # new edge has no stored reward yet; for revisits the stored
+        # value is identical by determinism).
         rec_node, rec_action = d["rec_node"], d["rec_action"]
         rec_active = d["rec_active"]  # (B, W, D)
         last_idx = rec_active.sum(axis=-1) - 1  # (B, W) deepest level
@@ -416,18 +413,27 @@ class BatchedMCTS:
             contrib.append(g)
         contrib.reverse()  # contrib[lvl] = G at level lvl, (B, W)
 
-        e_visits, e_value = tree.e_visits, tree.e_value
-        for lvl in range(depth):
-            act_mask = rec_active[:, :, lvl]
-            nd = jnp.maximum(rec_node[:, :, lvl], 0)
-            ac = jnp.maximum(rec_action[:, :, lvl], 0)
-            e_visits = e_visits.at[bcol, nd, ac].add(
-                act_mask.astype(jnp.float32)
-            )
-            e_value = e_value.at[bcol, nd, ac].add(
-                jnp.where(act_mask, contrib[lvl], 0.0)
-            )
-        tree = tree.replace(e_visits=e_visits, e_value=e_value)
+        e_visits, e_value, children, e_reward = backup_update(
+            tree.e_visits,
+            tree.e_value,
+            tree.children,
+            tree.e_reward,
+            parents,
+            actions,
+            jnp.where(is_new, slot_ids, -1.0),
+            rewards,
+            rec_node,
+            rec_action,
+            rec_active,
+            jnp.stack(contrib, axis=-1),
+            mode=cfg.backup_update,
+        )
+        tree = tree.replace(
+            e_visits=e_visits,
+            e_value=e_value,
+            children=children,
+            e_reward=e_reward,
+        )
 
         wasted = wasted + (w - live.sum(axis=1, dtype=jnp.int32))
         return tree, wasted, base + w

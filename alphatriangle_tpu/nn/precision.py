@@ -1,0 +1,56 @@
+"""Inference precision policy: bf16 params for rollout + serve forward.
+
+`ModelConfig.INFERENCE_PRECISION` selects the dtype the INFERENCE
+family (self-play chunk programs, `serve/b<B>` dispatch, arena/eval
+through the service) reads the network parameters at. The learner
+family is excluded by construction: the trainer holds and updates the
+f32 `TrainState`, and the fused megastep casts a bf16 *copy* of the
+params for its in-program rollout phase while the learner-step phase
+keeps consuming the f32 originals.
+
+What bf16 covers and what stays f32 (docs/KERNELS.md "Precision
+policy"): the cast applies to floating-point param/batch-stats leaves
+only. PER priorities, the cumsum the sampler searches, value targets,
+IS weights, optimizer state and gradients are untouched — priority
+ratios and learner math are precision-sensitive in ways an Elo-neutral
+forward pass is not (KataGo, arXiv:1902.10565, ships reduced-precision
+*inference* while training full-precision for exactly this reason).
+The model's value/policy heads already compute their final Dense in
+f32 (nn/model.py MLPHead), so logits keep f32 dynamic range even under
+a bf16 trunk.
+
+Caching: callers thread the cast through the AOT compile-cache
+signature for free — bf16 param avals change every leaf dtype in the
+program signature, and `config_digest(model_config)` (which now
+includes INFERENCE_PRECISION) is part of every inference family's
+`extra` tag, so f32 and bf16 programs cache as distinct entries with
+their own `.mem.json` sidecars.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..config.model_config import ModelConfig
+
+
+def inference_dtype(model_config: ModelConfig) -> jnp.dtype:
+    """The dtype the inference family reads params at."""
+    return jnp.dtype(
+        jnp.bfloat16
+        if model_config.INFERENCE_PRECISION == "bfloat16"
+        else jnp.float32
+    )
+
+
+def cast_params_for_inference(variables, model_config: ModelConfig):
+    """Cast the floating leaves of a variables pytree to the inference
+    dtype; identity (same object, no copy) under f32 policy."""
+    dtype = inference_dtype(model_config)
+    if dtype == jnp.float32:
+        return variables
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        variables,
+    )

@@ -1,5 +1,12 @@
-"""Custom TPU ops (Pallas kernels with portable fallbacks)."""
+"""Custom TPU ops (Pallas kernels with portable fallbacks).
+
+Every op follows one pattern (docs/KERNELS.md): a Pallas TPU lowering
+plus interchangeable XLA lowerings, numerically pinned against each
+other by parity tests, with a config knob selecting the backend.
+"""
 
 from .gather_rows import gather_rows
+from .mcts_backup import backup_update
+from .per_sample import per_sample
 
-__all__ = ["gather_rows"]
+__all__ = ["backup_update", "gather_rows", "per_sample"]

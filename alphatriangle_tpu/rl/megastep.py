@@ -100,6 +100,8 @@ import numpy as np
 
 from ..compile_cache import config_digest, get_compile_cache
 from ..config.train_config import TrainConfig
+from ..nn.precision import cast_params_for_inference
+from ..ops import per_sample
 from ..telemetry.flight import flight_span
 from .device_buffer import DeviceReplayBuffer, ring_scatter
 
@@ -173,6 +175,7 @@ class MegastepRunner:
         self.beta_initial = float(train_config.PER_BETA_INITIAL)
         self.beta_final = float(train_config.PER_BETA_FINAL)
         self.beta_anneal = float(train_config.PER_BETA_ANNEAL_STEPS or 1)
+        self.per_sample_backend = train_config.PER_SAMPLE_BACKEND
         # Device-resident priority array — the sampling truth inside
         # the program. Single-device: (cap + 1,) float32, the +1 the
         # trash slot pinned at priority 0 so it is never sampled.
@@ -226,28 +229,24 @@ class MegastepRunner:
         """On-device (K, B) slot sampling + IS weights.
 
         PER: stratified proportional sampling over the priority array
-        via inclusive-cumsum + searchsorted — the vectorized equivalent
-        of the host SumTree's stratified descent (utils/sumtree.py).
-        Zero-priority (empty/trash) slots are never selected: their
-        cumsum segments are empty. Uniform: floor(u * size).
+        (ops/per_sample.py; `TrainConfig.PER_SAMPLE_BACKEND` picks the
+        searchsorted or Pallas compare-count lowering) — the vectorized
+        equivalent of the host SumTree's stratified descent
+        (utils/sumtree.py). Zero-priority (empty/trash) slots are never
+        selected: their cumsum segments are empty. Uniform:
+        floor(u * size).
         """
         b = self.batch_size
         rng, k_sample = jax.random.split(state.rng)
         state = state.replace(rng=rng)
         if self.use_per:
-            cum = jnp.cumsum(priorities[: self.cap])
-            total = cum[-1]
-            u = (
-                (jnp.arange(b, dtype=jnp.float32)[None, :]
-                 + jax.random.uniform(k_sample, (k, b)))
-                / b
-                * total
-            )
-            idx = jnp.clip(
-                jnp.searchsorted(cum, u), 0, self.cap - 1
-            ).astype(jnp.int32)
-            probs = jnp.maximum(priorities[idx], 1e-12) / jnp.maximum(
-                total, 1e-12
+            idx, probs = per_sample(
+                priorities,
+                self.cap,
+                k,
+                b,
+                k_sample,
+                mode=self.per_sample_backend,
             )
             # Beta annealed on the learner-step clock, exactly as the
             # host mirror's `ExperienceBuffer.beta` computes it.
@@ -293,6 +292,12 @@ class MegastepRunner:
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
+        # Inference precision policy (nn/precision.py): the rollout
+        # phase reads a cast copy; the learner steps below keep
+        # consuming the f32 originals in `state`.
+        variables = cast_params_for_inference(
+            variables, self.trainer.nn.model_config
+        )
         new_carry, outs = self.engine._chunk(
             num_moves, variables, carry, state.step.astype(jnp.int32)
         )
@@ -385,6 +390,12 @@ class MegastepRunner:
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
+        # Inference precision policy (nn/precision.py): the rollout
+        # phase reads a cast copy; the learner steps below keep
+        # consuming the f32 originals in `state`.
+        variables = cast_params_for_inference(
+            variables, self.trainer.nn.model_config
+        )
         new_carry, outs = self.engine._chunk(
             num_moves, variables, carry, state.step.astype(jnp.int32)
         )

@@ -56,6 +56,7 @@ from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
 from ..telemetry.flight import flight_span
 from ..mcts.search import BatchedMCTS
 from ..nn.network import NeuralNetwork
+from ..nn.precision import cast_params_for_inference, inference_dtype
 from .types import SelfPlayResult
 
 logger = logging.getLogger(__name__)
@@ -164,6 +165,9 @@ class SelfPlayEngine:
         # streams sharing one net share one replicated copy instead of
         # uploading (and pinning in HBM) N of them.
         self._placed_variables: tuple | None = None
+        # (weights_version, inference-cast variables) memo for
+        # _inference_variables — same owner-chain sharing.
+        self._cast_variables: tuple | None = None
         self._placed_owner: "SelfPlayEngine" = (
             # Follow the chain so every stream lands on one root owner.
             share_compiled._placed_owner
@@ -332,6 +336,27 @@ class SelfPlayEngine:
         placed = jax.device_put(variables, self._replicated)
         owner._placed_variables = (version, placed)
         return placed
+
+    def _inference_variables(self, variables, version: int):
+        """Apply the inference precision policy (nn/precision.py) to
+        the net variables before a chunk dispatch: a bf16 copy under
+        INFERENCE_PRECISION="bfloat16", the original object under f32.
+        Memoized per weights version on the primary engine (the
+        `_place_variables` owner chain) so N rollout streams share one
+        cast copy; `astype` preserves NamedShardings, so the cast
+        composes with mesh placement."""
+        if inference_dtype(self.extractor.model_config) == jnp.float32:
+            return variables
+        owner = self._placed_owner
+        if owner._cast_variables is not None:
+            cached_version, cast = owner._cast_variables
+            if cached_version == version:
+                return cast
+        cast = cast_params_for_inference(
+            variables, self.extractor.model_config
+        )
+        owner._cast_variables = (version, cast)
+        return cast
 
     # --- device-side chunk ------------------------------------------------
 
@@ -570,7 +595,10 @@ class SelfPlayEngine:
             avals=f"B{self.batch_size}xT{t}",
         ):
             self._carry, outputs = self._chunk_fn(t)(
-                self._place_variables(self.net.variables, version),
+                self._place_variables(
+                    self._inference_variables(self.net.variables, version),
+                    version,
+                ),
                 self._carry,
                 jnp.int32(version),
             )
@@ -659,7 +687,10 @@ class SelfPlayEngine:
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         version = self.net.weights_version
         return self._chunk_fn(t).warm(
-            self._place_variables(self.net.variables, version),
+            self._place_variables(
+                self._inference_variables(self.net.variables, version),
+                version,
+            ),
             self._carry,
             jnp.int32(version),
         )
@@ -671,7 +702,10 @@ class SelfPlayEngine:
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         version = self.net.weights_version
         return self._chunk_fn(t).analyze(
-            self._place_variables(self.net.variables, version),
+            self._place_variables(
+                self._inference_variables(self.net.variables, version),
+                version,
+            ),
             self._carry,
             jnp.int32(version),
         )

@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.train_config import TrainConfig
+from ..ops import per_sample
 from ..utils.sumtree import SumTree
 from .buffer import ExperienceBuffer
 from .device_buffer import ring_scatter
@@ -85,6 +86,7 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         self.dp = dp
         self.cap_local = self.capacity // dp
         self.stride = self.cap_local + 1  # + per-shard trash row
+        self.per_sample_backend = config.PER_SAMPLE_BACKEND
         self._grid_shape = grid_shape
         self._other_dim = other_dim
 
@@ -282,9 +284,11 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         beta: jax.Array,
     ):
         """One shard's stratified (K, b_local) slot sampling inside an
-        enclosing `shard_map` body. PER: inclusive-cumsum + searchsorted
-        over the shard's own priority slice — the vectorized equivalent
-        of this shard's SumTree descent (utils/sumtree.py); zero-priority
+        enclosing `shard_map` body. PER: the shared stratified draw over
+        the shard's own priority slice (ops/per_sample.py;
+        `TrainConfig.PER_SAMPLE_BACKEND` picks the searchsorted or
+        Pallas compare-count lowering) — the vectorized equivalent of
+        this shard's SumTree descent (utils/sumtree.py); zero-priority
         (empty/trash) slots have empty cumsum segments and are never
         selected. IS weights come back UNNORMALIZED — the caller
         max-normalizes across the GLOBAL batch (a pmax over dp),
@@ -294,21 +298,13 @@ class ShardedDeviceReplayBuffer(ExperienceBuffer):
         Returns (local slot indices (K, b_local) int32, weights)."""
         size_f = size.astype(jnp.float32)
         if self.use_per:
-            cum = jnp.cumsum(priorities_local[: self.cap_local])
-            total = cum[-1]
-            u = (
-                (
-                    jnp.arange(b_local, dtype=jnp.float32)[None, :]
-                    + jax.random.uniform(key, (k, b_local))
-                )
-                / b_local
-                * total
-            )
-            idx = jnp.clip(
-                jnp.searchsorted(cum, u), 0, self.cap_local - 1
-            ).astype(jnp.int32)
-            probs = jnp.maximum(priorities_local[idx], 1e-12) / jnp.maximum(
-                total, 1e-12
+            idx, probs = per_sample(
+                priorities_local,
+                self.cap_local,
+                k,
+                b_local,
+                key,
+                mode=self.per_sample_backend,
             )
             weights = (size_f * probs) ** (-beta)
         else:

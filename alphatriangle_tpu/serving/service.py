@@ -126,14 +126,39 @@ class PolicyService:
         self._win_fill: list[float] = []
         self._win_requests = 0
         self._last_tick_t = clock()
+        # (weights_version, reload count, inference-cast variables)
+        # memo for _serve_variables (nn/precision.py).
+        self._cast_variables: "tuple | None" = None
 
     # --- warm start / pre-flight --------------------------------------
+
+    def _serve_variables(self):
+        """The variables the serve dispatch reads: the net's, cast to
+        the inference precision policy (nn/precision.py). Identity
+        under f32; under bf16 the cast copy is memoized per
+        (weights version, reload count) so steady-state dispatches
+        reuse one device-resident copy and a hot reload re-casts."""
+        from ..nn.precision import cast_params_for_inference, inference_dtype
+
+        import jax.numpy as jnp
+
+        cfg = self.extractor.model_config
+        if inference_dtype(cfg) == jnp.float32:
+            return self.net.variables
+        key = (self.net.weights_version, self.weight_reloads)
+        if self._cast_variables is not None:
+            cached_key, cast = self._cast_variables
+            if cached_key == key:
+                return cast
+        cast = cast_params_for_inference(self.net.variables, cfg)
+        self._cast_variables = (key, cast)
+        return cast
 
     def _sample_args(self):
         import jax
 
         return (
-            self.net.variables,
+            self._serve_variables(),
             self.sessions.states,
             jax.random.PRNGKey(0),
         )
@@ -232,7 +257,7 @@ class PolicyService:
                 avals=f"b{len(served)}",
             ):
                 out = self._search(
-                    self.net.variables, self.sessions.states, rng
+                    self._serve_variables(), self.sessions.states, rng
                 )
                 actions = select_root_actions(out, self.use_gumbel)
                 rewards, dones = self.sessions.step(actions, mask)

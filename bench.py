@@ -354,6 +354,16 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "full_search_prob": mcts_cfg.full_search_prob,
         },
         "descent_gather": mcts_cfg.descent_gather,
+        # Kernel-library provenance (docs/KERNELS.md): which lowering
+        # of each hot kernel + the rollout inference precision this
+        # measurement ran with — a bench row without these would be a
+        # mislabeled A/B the moment a non-default backend is flipped on.
+        "kernels": {
+            "descent_gather": mcts_cfg.descent_gather,
+            "backup_update": mcts_cfg.backup_update,
+            "per_sample": train_cfg.PER_SAMPLE_BACKEND,
+            "inference_precision": model_cfg.INFERENCE_PRECISION,
+        },
         "self_play_batch": sp_batch,
         "mcts_simulations": sims,
         "rollout_chunk_moves": chunk,

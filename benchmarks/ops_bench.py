@@ -1,0 +1,179 @@
+"""Kernel-library micro-benchmark: backend x shape grid with parity gate.
+
+Times every interchangeable lowering of the three hot kernels in
+alphatriangle_tpu/ops/ (docs/KERNELS.md) against each other:
+
+- gather_rows:    einsum | take | pallas   (MCTS descent row gather)
+- backup_update:  xla | pallas             (fused insertion + backup)
+- per_sample:     xla | pallas             (stratified PER draw)
+
+Every row is correctness-gated before it is timed: each backend's
+output must match the reference backend bit-for-bit (all three kernels
+are exact-parity by construction — see the module docstrings in ops/).
+A parity failure raises, so `make ops-smoke` is a CPU regression gate
+for the kernel library, not just a stopwatch. On CPU the Pallas rows
+run in interpret mode — their timings measure the interpreter, not the
+mosaic lowering; run on a TPU host for decision-grade numbers.
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/ops_bench.py
+Env:   OPS_BENCH_FULL=1  adds flagship-sized shapes (TPU hosts)
+Writes benchmarks/ops_bench_results.json.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from alphatriangle_tpu.ops import backup_update, gather_rows, per_sample
+
+FULL = os.environ.get("OPS_BENCH_FULL") == "1"
+
+# (B, N, A, W, D) tree shapes: smoke rows stay interpreter-friendly on
+# CPU; FULL adds the flagship self-play geometry (bench.py tpu tier).
+TREE_SHAPES = [(8, 65, 12, 8, 6), (16, 129, 24, 16, 8)] + (
+    [(256, 801, 72, 32, 12)] if FULL else []
+)
+# (cap, K, b) replay shapes: off- and on-tile-boundary capacities.
+PER_SHAPES = [(700, 2, 32), (4096, 4, 64)] + (
+    [(200_000, 16, 1024)] if FULL else []
+)
+
+
+def timed(fn, *args):
+    jax.block_until_ready(fn(*args))  # compile
+    reps = 5
+    t0 = time.time()
+    for _ in range(reps):
+        jax.block_until_ready(fn(*args))
+    return (time.time() - t0) / reps
+
+
+def assert_same(ref, got, label):
+    for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g), label)
+
+
+def bench_gather(rows):
+    rng = np.random.default_rng(0)
+    for b, n, a, w, _ in TREE_SHAPES:
+        k = a + 3  # stat row width: per-action stats + scalars
+        stats = jnp.asarray(rng.standard_normal((b, n, k)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, n, (b, w)), jnp.int32)
+        fns = {
+            m: jax.jit(lambda s, i, m=m: gather_rows(s, i, mode=m))
+            for m in ("einsum", "take", "pallas")
+        }
+        ref = fns["einsum"](stats, idx)
+        for mode, fn in fns.items():
+            assert_same(ref, fn(stats, idx), f"gather_rows[{mode}]")
+            rows.append(
+                {
+                    "kernel": "gather_rows",
+                    "backend": mode,
+                    "shape": {"B": b, "N": n, "K": k, "W": w},
+                    "mean_s": round(timed(fn, stats, idx), 5),
+                }
+            )
+            print(json.dumps(rows[-1]), flush=True)
+
+
+def bench_backup(rows):
+    rng = np.random.default_rng(1)
+    for b, n, a, w, d in TREE_SHAPES:
+        ops = (
+            jnp.asarray(rng.standard_normal((b, n, a)), jnp.float32),
+            jnp.asarray(rng.standard_normal((b, n, a)), jnp.float32),
+            jnp.asarray(
+                rng.integers(-1, n, (b, n, a)).astype(np.float32)
+            ),
+            jnp.asarray(rng.standard_normal((b, n, a)), jnp.float32),
+            jnp.asarray(rng.integers(0, n, (b, w)), jnp.int32),
+            jnp.asarray(rng.integers(0, a, (b, w)), jnp.int32),
+            jnp.asarray(
+                np.where(
+                    rng.random((b, w)) < 0.5,
+                    rng.integers(0, n, (b, w)),
+                    -1,
+                ).astype(np.float32)
+            ),
+            jnp.asarray(rng.standard_normal((b, w)), jnp.float32),
+            # narrow index ranges force duplicate edges, the ordering-
+            # sensitive case the parity gate must hold on:
+            jnp.asarray(rng.integers(-1, n // 2, (b, w, d)), jnp.int32),
+            jnp.asarray(rng.integers(-1, a // 2, (b, w, d)), jnp.int32),
+            jnp.asarray(
+                (rng.random((b, w, d)) < 0.7).astype(np.int32)
+            ),
+            jnp.asarray(rng.standard_normal((b, w, d)), jnp.float32),
+        )
+        fns = {
+            m: jax.jit(lambda *o, m=m: backup_update(*o, mode=m))
+            for m in ("xla", "pallas")
+        }
+        ref = fns["xla"](*ops)
+        for mode, fn in fns.items():
+            assert_same(ref, fn(*ops), f"backup_update[{mode}]")
+            rows.append(
+                {
+                    "kernel": "backup_update",
+                    "backend": mode,
+                    "shape": {"B": b, "N": n, "A": a, "W": w, "D": d},
+                    "mean_s": round(timed(fn, *ops), 5),
+                }
+            )
+            print(json.dumps(rows[-1]), flush=True)
+
+
+def bench_per_sample(rows):
+    rng = np.random.default_rng(2)
+    for cap, k, b in PER_SHAPES:
+        pri = jnp.asarray(rng.random(cap), jnp.float32)
+        key = jax.random.PRNGKey(7)
+        fns = {
+            m: jax.jit(
+                lambda p, kk, m=m: per_sample(p, cap, k, b, kk, mode=m)
+            )
+            for m in ("xla", "pallas")
+        }
+        ref = fns["xla"](pri, key)
+        for mode, fn in fns.items():
+            assert_same(ref, fn(pri, key), f"per_sample[{mode}]")
+            rows.append(
+                {
+                    "kernel": "per_sample",
+                    "backend": mode,
+                    "shape": {"cap": cap, "K": k, "b": b},
+                    "mean_s": round(timed(fn, pri, key), 5),
+                }
+            )
+            print(json.dumps(rows[-1]), flush=True)
+
+
+def main() -> None:
+    rows: list[dict] = []
+    bench_gather(rows)
+    bench_backup(rows)
+    bench_per_sample(rows)
+    report = {
+        "backend": jax.default_backend(),
+        "interpret_pallas": jax.default_backend() != "tpu",
+        "full": FULL,
+        "rows": rows,
+    }
+    out_path = Path(__file__).parent / "ops_bench_results.json"
+    out_path.write_text(json.dumps(report, indent=2))
+    print(f"parity gate passed for all {len(rows)} rows -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()

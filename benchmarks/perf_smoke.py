@@ -82,6 +82,11 @@ def tiny_configs():
         OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
         NUM_VALUE_ATOMS=11,
         COMPUTE_DTYPE="float32",
+        # The smokes run with the bf16 inference path ON (nn/precision.py,
+        # docs/KERNELS.md): rollout + serve forwards consume bf16-cast
+        # params while the learner keeps updating the f32 originals —
+        # this gate proves the cast path end to end on CPU, not speed.
+        INFERENCE_PRECISION="bfloat16",
     )
     mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=4, max_depth=4)
     train_cfg = TrainConfig(

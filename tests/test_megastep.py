@@ -78,8 +78,10 @@ def make_cfg(run_name: str, **kw) -> TrainConfig:
     return TrainConfig(**base)
 
 
-def build(tmp_path, cfgs, run_name="mega_run", **kw):
+def build(tmp_path, cfgs, run_name="mega_run", mcts_kw=None, **kw):
     env_cfg, model_cfg, mcts_cfg = cfgs
+    if mcts_kw:
+        mcts_cfg = mcts_cfg.model_copy(update=mcts_kw)
     tc = make_cfg(run_name, **kw)
     pc = PersistenceConfig(ROOT_DATA_DIR=str(tmp_path), RUN_NAME=run_name)
     return setup_training_components(
@@ -143,7 +145,16 @@ class TestMegastepLoop:
         monkeypatch.setenv("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
         # 2-move chunks keep the fused program's scan short (tier-1
         # compile budget); the loop semantics are chunk-length-free.
-        c = build(tmp_path, tiny_world_configs, ROLLOUT_CHUNK_MOVES=2)
+        # Every Pallas backend is enabled (interpret mode on CPU), so
+        # the one-dispatch contract is asserted with the full kernel
+        # library inside the fused program (ops/, docs/KERNELS.md).
+        c = build(
+            tmp_path,
+            tiny_world_configs,
+            ROLLOUT_CHUNK_MOVES=2,
+            PER_SAMPLE_BACKEND="pallas",
+            mcts_kw={"descent_gather": "pallas", "backup_update": "pallas"},
+        )
         params0 = jax.device_get(c.trainer.state.params)
         loop = TrainingLoop(c)
         status = loop.run()

@@ -1,13 +1,16 @@
-"""Custom-op tests: the three descent row-gather lowerings must be
-numerically identical (the Pallas kernel runs in interpret mode on
-CPU), and full searches must be invariant to the choice."""
+"""Custom-op tests: every ops/ lowering pair must be numerically
+pinned against the other (the Pallas kernels run in interpret mode on
+CPU) — exact for the gather, backup and PER index-select ops, and
+tolerance + fixed-seed arena equality for the bf16 inference path —
+and full searches must be invariant to the backend choice."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from alphatriangle_tpu.mcts import BatchedMCTS
-from alphatriangle_tpu.ops import gather_rows
+from alphatriangle_tpu.ops import backup_update, gather_rows, per_sample
 
 
 class TestGatherRows:
@@ -40,6 +43,138 @@ class TestGatherRows:
             )
 
 
+class TestPerSample:
+    """Stratified PER draw: the Pallas compare-count and XLA
+    searchsorted lowerings share one prefix-sum, so index selection is
+    bit-identical by construction."""
+
+    # cap off/on the kernel tile boundary, below one tile, K=1.
+    @pytest.mark.parametrize("cap,k,b", [(37, 2, 8), (512, 1, 16), (700, 3, 32)])
+    def test_pallas_matches_xla_exactly(self, cap, k, b):
+        key = jax.random.PRNGKey(3)
+        prios = jax.random.uniform(jax.random.PRNGKey(7), (cap + 1,))
+        prios = prios.at[cap].set(0.0)  # trash slot
+        idx_x, probs_x = per_sample(prios, cap, k, b, key, mode="xla")
+        idx_p, probs_p = per_sample(prios, cap, k, b, key, mode="pallas")
+        np.testing.assert_array_equal(np.asarray(idx_x), np.asarray(idx_p))
+        np.testing.assert_array_equal(
+            np.asarray(probs_x), np.asarray(probs_p)
+        )
+        assert idx_p.dtype == jnp.int32 and probs_p.dtype == jnp.float32
+
+    @pytest.mark.parametrize("mode", ["xla", "pallas"])
+    def test_draw_is_stratified_proportional(self, mode):
+        """Each selected slot must bound its stratum draw:
+        cum[idx-1] <= u < cum[idx] (the SumTree descent invariant)."""
+        cap, k, b = 133, 2, 16
+        prios = jax.random.uniform(jax.random.PRNGKey(9), (cap,))
+        cum = np.cumsum(np.asarray(prios))
+        key = jax.random.PRNGKey(11)
+        idx, _ = per_sample(prios, cap, k, b, key, mode=mode)
+        # Reconstruct the shared stratum draws exactly as per_sample.
+        u = np.asarray(
+            (
+                jnp.arange(b, dtype=jnp.float32)[None, :]
+                + jax.random.uniform(key, (k, b))
+            )
+            / b
+            * jnp.cumsum(prios[:cap])[-1]
+        )
+        idx = np.asarray(idx)
+        assert (u[idx > 0] >= cum[idx[idx > 0] - 1]).all()
+        assert (u[idx < cap - 1] <= cum[idx[idx < cap - 1]]).all()
+
+    @pytest.mark.parametrize("mode", ["xla", "pallas"])
+    def test_zero_priority_never_selected(self, mode):
+        """Empty/trash slots have empty cumsum segments."""
+        cap = 64
+        prios = jnp.zeros((cap,)).at[jnp.array([3, 17, 40])].set(1.0)
+        idx, probs = per_sample(
+            prios, cap, 4, 8, jax.random.PRNGKey(13), mode=mode
+        )
+        assert set(np.asarray(idx).ravel()) <= {3, 17, 40}
+        assert (np.asarray(probs) > 0).all()
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="unknown PER sample"):
+            per_sample(
+                jnp.ones((8,)), 8, 1, 4, jax.random.PRNGKey(0), mode="x"
+            )
+
+
+def _backup_operands(seed=2, batch=3, n=9, a=12, w=4, depth=5):
+    """Random edge planes + a random (not necessarily consistent)
+    descent record, with duplicate (node, action) hits across members
+    and levels so update-order semantics are actually exercised."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 12)
+    return dict(
+        e_visits=jax.random.uniform(ks[0], (batch, n, a)),
+        e_value=jax.random.normal(ks[1], (batch, n, a)),
+        children=jnp.full((batch, n, a), -1.0).at[:, 0, :3].set(1.0),
+        e_reward=jax.random.normal(ks[2], (batch, n, a)),
+        parents=jax.random.randint(ks[3], (batch, w), 0, n),
+        actions=jax.random.randint(ks[4], (batch, w), 0, a),
+        new_child=jnp.where(
+            jax.random.bernoulli(ks[5], 0.5, (batch, w)),
+            jax.random.randint(ks[6], (batch, w), 1, n).astype(jnp.float32),
+            -1.0,
+        ),
+        rewards=jax.random.normal(ks[7], (batch, w)),
+        rec_node=jax.random.randint(ks[8], (batch, w, depth), -1, 3),
+        rec_action=jax.random.randint(ks[9], (batch, w, depth), -1, 4),
+        rec_active=jax.random.bernoulli(ks[10], 0.7, (batch, w, depth)),
+        returns=jax.random.normal(ks[11], (batch, w, depth)),
+    )
+
+
+class TestBackupUpdate:
+    def test_pallas_matches_xla_exactly(self):
+        ops = _backup_operands()
+        outs_x = backup_update(*ops.values(), mode="xla")
+        outs_p = backup_update(*ops.values(), mode="pallas")
+        for got, want in zip(outs_p, outs_x):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_xla_matches_numpy_reference(self):
+        """The op must reproduce the scatter math `_wave` originally
+        spelled, computed here as a sequential numpy loop."""
+        ops = _backup_operands(seed=5)
+        ev, eq, ch, er = (
+            np.asarray(ops[k], np.float64)
+            for k in ("e_visits", "e_value", "children", "e_reward")
+        )
+        parents, actions = np.asarray(ops["parents"]), np.asarray(ops["actions"])
+        new_child = np.asarray(ops["new_child"])
+        rewards = np.asarray(ops["rewards"])
+        rec_node, rec_action = np.asarray(ops["rec_node"]), np.asarray(ops["rec_action"])
+        rec_active = np.asarray(ops["rec_active"])
+        returns = np.asarray(ops["returns"])
+        batch, w = parents.shape
+        depth = rec_node.shape[-1]
+        for bi in range(batch):
+            for j in range(w):
+                p, ac = parents[bi, j], actions[bi, j]
+                ch[bi, p, ac] = max(ch[bi, p, ac], new_child[bi, j])
+                er[bi, p, ac] = rewards[bi, j]
+            for lvl in range(depth):
+                for j in range(w):
+                    nd = max(rec_node[bi, j, lvl], 0)
+                    ac = max(rec_action[bi, j, lvl], 0)
+                    if rec_active[bi, j, lvl]:
+                        ev[bi, nd, ac] += 1.0
+                        eq[bi, nd, ac] += returns[bi, j, lvl]
+        got = backup_update(*ops.values(), mode="xla")
+        for g, want in zip(got, (ev, eq, ch, er)):
+            np.testing.assert_allclose(
+                np.asarray(g), want.astype(np.float32), atol=1e-5
+            )
+
+    def test_unknown_mode_raises(self):
+        ops = _backup_operands()
+        with pytest.raises(ValueError, match="unknown backup"):
+            backup_update(*ops.values(), mode="x")
+
+
 class TestSearchGatherInvariance:
     def test_search_identical_across_modes(
         self, tiny_env_config, tiny_model_config, tiny_mcts_config
@@ -66,3 +201,152 @@ class TestSearchGatherInvariance:
             )
         np.testing.assert_array_equal(outs["einsum"], outs["take"])
         np.testing.assert_array_equal(outs["einsum"], outs["pallas"])
+
+
+def _tiny_net(tiny_env_config, tiny_model_config):
+    from alphatriangle_tpu.env.engine import TriangleEnv
+    from alphatriangle_tpu.features.core import get_feature_extractor
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+
+    env = TriangleEnv(tiny_env_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+    return env, fe, net
+
+
+class TestSearchBackupInvariance:
+    def test_search_identical_across_backup_modes(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        env, fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        roots = env.reset_batch(jax.random.split(jax.random.PRNGKey(4), 4))
+        outs = {}
+        for mode in ("xla", "pallas"):
+            cfg = tiny_mcts_config.model_copy(update={"backup_update": mode})
+            mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+            out = mcts.search(net.variables, roots, jax.random.PRNGKey(5))
+            outs[mode] = (
+                np.asarray(out.visit_counts),
+                np.asarray(out.root_value),
+            )
+        np.testing.assert_array_equal(outs["xla"][0], outs["pallas"][0])
+        np.testing.assert_array_equal(outs["xla"][1], outs["pallas"][1])
+
+    def test_fixed_seed_chunk_bit_identical(
+        self,
+        tiny_env_config,
+        tiny_model_config,
+        tiny_mcts_config,
+        tiny_train_config,
+    ):
+        """A whole self-play chunk (search + select + step + n-step
+        window) must be bit-identical under either backup backend —
+        the rollout-program-level parity pin."""
+        from alphatriangle_tpu.rl.self_play import SelfPlayEngine
+
+        env, fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        harvests = {}
+        for mode in ("xla", "pallas"):
+            engine = SelfPlayEngine(
+                env,
+                fe,
+                net,
+                tiny_mcts_config.model_copy(update={"backup_update": mode}),
+                tiny_train_config,
+                batch_size=4,
+                seed=123,
+            )
+            engine.play_chunk(2)
+            result = engine.harvest()
+            harvests[mode] = (
+                result.policy_target,
+                result.value_target,
+                np.asarray(engine.states.score),
+            )
+        for got, want in zip(harvests["pallas"], harvests["xla"]):
+            np.testing.assert_array_equal(got, want)
+
+
+class TestInferencePrecision:
+    def test_f32_policy_is_identity(self, tiny_model_config):
+        from alphatriangle_tpu.nn.precision import (
+            cast_params_for_inference,
+            inference_dtype,
+        )
+
+        assert inference_dtype(tiny_model_config) == jnp.float32
+        variables = {"params": {"w": jnp.ones((2, 2))}}
+        assert (
+            cast_params_for_inference(variables, tiny_model_config)
+            is variables
+        )
+
+    def test_bf16_forward_within_tolerance(
+        self, tiny_env_config, tiny_model_config
+    ):
+        """bf16-cast params must give close (not bit-equal: the heads'
+        final f32 Dense sees rounded weights) priors and values."""
+        from alphatriangle_tpu.nn.precision import cast_params_for_inference
+
+        env, fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        bf16_cfg = tiny_model_config.model_copy(
+            update={"INFERENCE_PRECISION": "bfloat16"}
+        )
+        cast = cast_params_for_inference(net.variables, bf16_cfg)
+        leaf = jax.tree_util.tree_leaves(cast["params"])[0]
+        assert leaf.dtype == jnp.bfloat16
+        states = env.reset_batch(jax.random.split(jax.random.PRNGKey(8), 8))
+        grids, others = jax.vmap(fe.extract)(states)
+        pol_f32, val_f32 = net.model.apply(
+            net.variables, grids, others, train=False
+        )
+        pol_bf16, val_bf16 = net.model.apply(cast, grids, others, train=False)
+        assert pol_bf16.dtype == jnp.float32  # heads stay f32
+        p32 = jax.nn.softmax(pol_f32, axis=-1)
+        p16 = jax.nn.softmax(pol_bf16, axis=-1)
+        np.testing.assert_allclose(
+            np.asarray(p16), np.asarray(p32), atol=0.05
+        )
+        np.testing.assert_allclose(
+            np.asarray(val_bf16), np.asarray(val_f32), atol=0.2, rtol=0.1
+        )
+
+    def test_fixed_seed_arena_equality(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        """Paired-hands arena (arena.py): the same fixed-seed games
+        played greedily under f32 vs bf16 inference must score within
+        tolerance — the Elo-neutrality gate for the precision policy
+        (KataGo, arXiv:1902.10565)."""
+        from alphatriangle_tpu.arena import greedy_mcts_policy, play
+        from alphatriangle_tpu.nn.precision import cast_params_for_inference
+
+        env, fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        cfg = tiny_mcts_config.model_copy(update={"wave_noise_scale": 0.0})
+        mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+        bf16_cfg = tiny_model_config.model_copy(
+            update={"INFERENCE_PRECISION": "bfloat16"}
+        )
+
+        class _Net:
+            def __init__(self, variables):
+                self.variables = variables
+
+        scores = {}
+        for name, variables in (
+            ("f32", net.variables),
+            ("bf16", cast_params_for_inference(net.variables, bf16_cfg)),
+        ):
+            s, _, _ = play(
+                env,
+                greedy_mcts_policy(_Net(variables), mcts),
+                games=4,
+                max_moves=8,
+                seed=21,
+            )
+            scores[name] = s
+        # Paired hands strip hand luck; a per-game score gap only
+        # appears where rounding flips a near-tie move choice.
+        assert (
+            abs(float(scores["bf16"].mean() - scores["f32"].mean())) <= 3.0
+        )
