@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke
 
 check: lint type test
 
@@ -64,6 +64,18 @@ perf-smoke:
 #   $(PY) benchmarks/serve_smoke.py --write-reference
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_smoke.py
+
+# Experience-flywheel gate (docs/LEAGUE.md): seed a league pool from a
+# tiny CPU run's checkpoints, then `cli league` must train the learner
+# while a PolicyService plays matchmade pool games whose trajectories
+# verifiably reach the replay ring (ledger `kind:"league"` records with
+# ingest counts + staleness tags), promote the live net at least once
+# under a permissive gate, keep league.jsonl's rating events consistent
+# with its result events, surface the league fields through `cli perf
+# --json` / `cli compare`, and leave a checkpoint that resumes under
+# plain training.
+league-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/league_smoke.py
 
 # Window-forensics gate (docs/OBSERVABILITY.md "Flight recorder"):
 # a synthetic torn flight ring must classify as dispatch-hung naming

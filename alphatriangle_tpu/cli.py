@@ -643,6 +643,13 @@ def cmd_perf(args: argparse.Namespace) -> int:
     programs = summarize_flight(read_flight(ledger.parent / FLIGHT_FILENAME))
     if programs:
         summary["programs"] = programs
+    # League flywheel fold (league/flywheel.py `kind:"league"` records):
+    # flywheel runs gain the league_* fields and the league line below.
+    from .telemetry.perf import summarize_league
+
+    league = summarize_league(read_ledger(ledger, kinds={"league"}))
+    if league is not None:
+        summary.update(league)
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -707,6 +714,17 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   {_fmt_cell(summary.get('serve_requests_per_sec'), ',.1f')} req/s"
             f"   fill {_fmt_cell(summary.get('serve_batch_fill'), ',.0f', 100.0, '%')}"
             f"   reloads {_fmt_cell(summary.get('serve_weight_reloads'), ',.0f')}"
+        )
+    if league is not None:
+        print(
+            f"  league       pool {_fmt_cell(summary.get('league_pool_size'), ',.0f')}"
+            f"   rounds {_fmt_cell(summary.get('league_rounds'), ',.0f')}"
+            f"   ingest {_fmt_cell(summary.get('league_ingested_moves_per_sec'), ',.1f')} moves/s"
+            f" ({_fmt_cell(summary.get('league_moves_ingested'), ',.0f')} total)"
+            f"   staleness {_fmt_cell(summary.get('league_mean_staleness'), ',.1f')}"
+            f"   stale dropped {_fmt_cell(summary.get('league_stale_dropped'), ',.0f')}"
+            f"   promotions {_fmt_cell(summary.get('league_promotions'), ',.0f')}"
+            f"   live elo {_fmt_cell(summary.get('league_live_elo'), ',.1f')}"
         )
     if programs:
         # Measured per-program device time (flight recorder seals) —
@@ -1525,6 +1543,135 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ).exists()
         return 0 if ok else 1
     return 0
+
+
+def cmd_league(args: argparse.Namespace) -> int:
+    """Experience-flywheel mode (docs/LEAGUE.md): one process runs the
+    learner while a `PolicyService` plays matchmade games against a
+    league of past checkpoints, the served trajectories flowing into
+    the replay ring interleaved with self-play at --mix. The pool is
+    seeded from --pool-from's checkpoints; the flywheel run's own
+    promotions grow it. Board/net configs come from the pool run's
+    configs.json so pool checkpoints actually load.
+
+    Emits one JSON report line (pool size, ratings, promotions,
+    ingest) — the `make league-smoke` contract."""
+    import json as _json
+
+    from .config import (
+        AlphaTriangleMCTSConfig,
+        LeagueConfig,
+        PersistenceConfig,
+        TrainConfig,
+    )
+    from .config.run_configs import load_run_configs_or_default
+    from .league import LEAGUE_FILENAME, LIVE_ID, LeaguePool, run_flywheel
+
+    def persistence_for(run_name: str) -> "PersistenceConfig":
+        p = PersistenceConfig(RUN_NAME=run_name)
+        if args.root_dir:
+            p = p.model_copy(update={"ROOT_DATA_DIR": args.root_dir})
+        return p
+
+    overrides: dict = {
+        # Auto-resume would redirect RUN_NAME at the newest
+        # checkpointed run — typically the --pool-from source itself —
+        # and train INTO it. The flywheel names its run explicitly.
+        "AUTO_RESUME_LATEST": False,
+    }
+    if args.run_name is not None:
+        overrides["RUN_NAME"] = args.run_name
+    if args.seed is not None:
+        overrides["RANDOM_SEED"] = args.seed
+    if args.steps is not None:
+        overrides["MAX_TRAINING_STEPS"] = args.steps
+    if args.self_play_batch is not None:
+        overrides["SELF_PLAY_BATCH_SIZE"] = args.self_play_batch
+    if args.batch_size is not None:
+        overrides["BATCH_SIZE"] = args.batch_size
+    if args.buffer_capacity is not None:
+        overrides["BUFFER_CAPACITY"] = args.buffer_capacity
+    if args.min_buffer is not None:
+        overrides["MIN_BUFFER_SIZE_TO_TRAIN"] = args.min_buffer
+    if args.rollout_chunk is not None:
+        overrides["ROLLOUT_CHUNK_MOVES"] = args.rollout_chunk
+    if args.checkpoint_freq is not None:
+        overrides["CHECKPOINT_SAVE_FREQ_STEPS"] = args.checkpoint_freq
+    if args.device_replay is not None:
+        overrides["DEVICE_REPLAY"] = args.device_replay
+    if args.max_moves is not None:
+        overrides["MAX_EPISODE_MOVES"] = args.max_moves
+    if args.device is not None:
+        overrides["DEVICE"] = args.device
+    train_config = TrainConfig(**overrides)
+
+    league_kw: dict = {}
+    if args.slots is not None:
+        league_kw["LEAGUE_SLOTS"] = args.slots
+    if args.games is not None:
+        league_kw["GAMES_PER_ROUND"] = args.games
+    if args.mix is not None:
+        league_kw["LEAGUE_MIX_RATIO"] = args.mix
+    if args.max_moves is not None:
+        league_kw["MAX_GAME_MOVES"] = args.max_moves
+    if args.reload_every is not None:
+        league_kw["RELOAD_EVERY_STEPS"] = args.reload_every
+    if args.staleness_window is not None:
+        league_kw["STALENESS_WINDOW"] = args.staleness_window
+    if args.promotion_games is not None:
+        league_kw["PROMOTION_MIN_GAMES"] = args.promotion_games
+    if args.promotion_win_rate is not None:
+        league_kw["PROMOTION_WIN_RATE"] = args.promotion_win_rate
+    if args.exploration_floor is not None:
+        league_kw["EXPLORATION_FLOOR"] = args.exploration_floor
+    league_config = LeagueConfig(**league_kw)
+
+    # Board/net configs from the pool source run: the pool's
+    # checkpoints must restore into this geometry.
+    cfg_dir = persistence_for(args.pool_from).get_run_base_dir()
+    env_config, model_config = load_run_configs_or_default(cfg_dir)
+    mcts_config = (
+        AlphaTriangleMCTSConfig(max_simulations=args.sims)
+        if args.sims is not None
+        else None
+    )
+
+    telemetry_config = None
+    if args.no_telemetry:
+        from .config import TelemetryConfig
+
+        telemetry_config = TelemetryConfig(ENABLED=False)
+
+    persistence_config = persistence_for(train_config.RUN_NAME)
+    code = run_flywheel(
+        train_config=train_config,
+        league_config=league_config,
+        env_config=env_config,
+        model_config=model_config,
+        mcts_config=mcts_config,
+        persistence_config=persistence_config,
+        telemetry_config=telemetry_config,
+        pool_from=args.pool_from,
+        use_tensorboard=False,
+    )
+
+    run_dir = persistence_config.get_run_base_dir()
+    pool = LeaguePool(run_dir / LEAGUE_FILENAME)
+    report = {
+        "run": train_config.RUN_NAME,
+        "pool_from": args.pool_from,
+        "exit": code,
+        "pool_size": len(pool),
+        "promotions": pool.promotions,
+        "live_elo": round(pool.rating(LIVE_ID), 2),
+        "ratings": {
+            m: round(pool.rating(m), 2) for m in pool.member_ids()
+        },
+        "league_jsonl": str(run_dir / LEAGUE_FILENAME),
+        "ledger": str(run_dir / "metrics.jsonl"),
+    }
+    print(_json.dumps(report))
+    return code
 
 
 def cmd_fit(args: argparse.Namespace) -> int:
@@ -2402,6 +2549,82 @@ def main(argv: list[str] | None = None) -> int:
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
 
+    league = sub.add_parser(
+        "league",
+        help="Experience-flywheel mode: learner + matchmade league "
+        "games through a PolicyService in one process, served "
+        "trajectories flowing into the replay ring alongside "
+        "self-play (docs/LEAGUE.md).",
+    )
+    league.add_argument(
+        "--pool-from",
+        required=True,
+        metavar="RUN",
+        help="Seed the opponent pool from this run's checkpoints (its "
+        "configs.json also supplies the board/net geometry).",
+    )
+    league.add_argument("--run-name", default=None)
+    league.add_argument("--root-dir", default=None)
+    league.add_argument("--steps", type=int, default=None, metavar="N",
+                        help="MAX_TRAINING_STEPS for the learner.")
+    league.add_argument(
+        "--mix",
+        type=float,
+        default=None,
+        metavar="RATIO",
+        help="Fraction of iterations that play a league round instead "
+        "of a self-play chunk (default 0.25).",
+    )
+    league.add_argument(
+        "--slots",
+        type=int,
+        default=None,
+        metavar="B",
+        help="League service session slots (= serve/b<B> shape).",
+    )
+    league.add_argument(
+        "--games",
+        type=int,
+        default=None,
+        metavar="G",
+        help="Games per side per matchmade pairing.",
+    )
+    league.add_argument("--sims", type=int, default=None)
+    league.add_argument("--max-moves", type=int, default=None)
+    league.add_argument(
+        "--reload-every",
+        type=int,
+        default=None,
+        metavar="STEPS",
+        help="Broadcast fresh learner params to the league service "
+        "every N learner steps (default 8).",
+    )
+    league.add_argument(
+        "--staleness-window",
+        type=int,
+        default=None,
+        metavar="RELOADS",
+        help="Drop harvested rows more than this many reloads behind "
+        "the learner (default 4; negative disables).",
+    )
+    league.add_argument("--promotion-games", type=int, default=None)
+    league.add_argument("--promotion-win-rate", type=float, default=None)
+    league.add_argument("--exploration-floor", type=float, default=None)
+    league.add_argument("--seed", type=int, default=None)
+    league.add_argument("--self-play-batch", type=int, default=None)
+    league.add_argument("--batch-size", type=int, default=None)
+    league.add_argument("--buffer-capacity", type=int, default=None)
+    league.add_argument("--min-buffer", type=int, default=None)
+    league.add_argument("--rollout-chunk", type=int, default=None)
+    league.add_argument("--checkpoint-freq", type=int, default=None)
+    league.add_argument(
+        "--device-replay", default=None, choices=["auto", "on", "off"]
+    )
+    league.add_argument("--no-telemetry", action="store_true")
+    league.add_argument(
+        "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+
     mem = sub.add_parser(
         "mem",
         help="Memory-attribution table for a run (programs, train "
@@ -2565,6 +2788,7 @@ def main(argv: list[str] | None = None) -> int:
         "warm": cmd_warm,
         "fit": cmd_fit,
         "serve": cmd_serve,
+        "league": cmd_league,
         "mem": cmd_mem,
     }
     return handlers[args.command](args)

@@ -2,6 +2,7 @@
 
 from alphatriangle_tpu.config.app_config import APP_NAME
 from alphatriangle_tpu.config.env_config import EnvConfig
+from alphatriangle_tpu.config.league_config import LeagueConfig
 from alphatriangle_tpu.config.mcts_config import AlphaTriangleMCTSConfig, MCTSConfig
 from alphatriangle_tpu.config.mesh_config import MeshConfig
 from alphatriangle_tpu.config.model_config import ModelConfig
@@ -26,6 +27,7 @@ __all__ = [
     "AlphaTriangleMCTSConfig",
     "EnvConfig",
     "GEOMETRY_PRESETS",
+    "LeagueConfig",
     "MCTSConfig",
     "MeshConfig",
     "ModelConfig",

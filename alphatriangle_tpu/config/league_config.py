@@ -1,0 +1,64 @@
+"""League / flywheel configuration.
+
+Knobs for the experience flywheel (`alphatriangle_tpu/league/`): how
+many service lanes play matchmade games, how league data mixes with
+self-play in the learner's diet, the params-broadcast cadence, the
+staleness window the ingest guard enforces, and the KataGo-style
+matchmaking + promotion parameters. One pydantic model, same idiom as
+the sibling configs — constructed by `cli league` from flags and
+serialized into the run's configs.json.
+"""
+
+from pydantic import BaseModel, Field, model_validator
+
+
+class LeagueConfig(BaseModel):
+    """Flywheel-mode hyperparameters (pydantic)."""
+
+    # --- Service sizing ---
+    # Session slots on the league PolicyService: games per matchmade
+    # round play in lockstep through the serve dispatch path.
+    LEAGUE_SLOTS: int = Field(default=8, ge=1)
+    # Games per side per pairing (live vs. opponent each play this
+    # many); the win fraction of the pairing is the Elo observation.
+    GAMES_PER_ROUND: int = Field(default=4, ge=1)
+    # Hard cap on moves per league game (mirrors MAX_EPISODE_MOVES).
+    MAX_GAME_MOVES: int = Field(default=200, ge=1)
+
+    # --- Learner diet ---
+    # Fraction of loop iterations that run a league round instead of a
+    # self-play rollout chunk. 0.0 = pure self-play (flywheel off),
+    # 1.0 = every iteration plays league games. Fractions accumulate:
+    # 0.25 plays one league round every 4th iteration.
+    LEAGUE_MIX_RATIO: float = Field(default=0.25, ge=0.0, le=1.0)
+    # Broadcast fresh learner params to the league service every N
+    # learner steps (RLAX-style step-clock broadcast). The broadcast
+    # bumps the service's hot-reload counter — the staleness tag.
+    RELOAD_EVERY_STEPS: int = Field(default=8, ge=1)
+    # Drop harvested rows whose params version trails the learner's
+    # broadcast clock by more than this many reloads (None/negative =
+    # guard off). Counted in Stats/stale_dropped.
+    STALENESS_WINDOW: int | None = Field(default=4)
+
+    # --- Matchmaking (KataGo-style) ---
+    # Elo-gap scale of the proximity kernel.
+    MATCH_TEMPERATURE: float = Field(default=200.0, gt=0.0)
+    # Uniform mass spread over the whole pool so no member is starved.
+    EXPLORATION_FLOOR: float = Field(default=0.1, ge=0.0, le=1.0)
+    ELO_K: float = Field(default=32.0, gt=0.0)
+
+    # --- Promotion gate ---
+    # Live net joins the pool once its matchmade win-rate clears the
+    # gate over at least this many pairings; the window then resets.
+    PROMOTION_MIN_GAMES: int = Field(default=4, ge=1)
+    PROMOTION_WIN_RATE: float = Field(default=0.55, ge=0.0, le=1.0)
+
+    @model_validator(mode="after")
+    def _check(self) -> "LeagueConfig":
+        if self.GAMES_PER_ROUND > self.LEAGUE_SLOTS:
+            raise ValueError(
+                "GAMES_PER_ROUND cannot exceed LEAGUE_SLOTS "
+                f"({self.GAMES_PER_ROUND} > {self.LEAGUE_SLOTS}): a round's "
+                "games play in one set of service sessions."
+            )
+        return self

@@ -1,0 +1,48 @@
+"""League subsystem: the experience flywheel (served games → replay).
+
+Three pieces close the serve→replay loop (ROADMAP "Experience
+flywheel"): the trajectory emitter harvests `(features, visit policy,
+outcome)` rows from `PolicyService` dispatches with staleness tags;
+the pool + matchmaker keep a crash-safe `league.jsonl` population of
+past checkpoints with Elo ratings and KataGo-style opponent sampling;
+the flywheel loop interleaves matchmade league games with self-play
+into one learner. See docs/LEAGUE.md.
+"""
+
+from .emitter import TrajectoryEmitter, apply_staleness_guard, merge_results
+from .matchmaker import Matchmaker
+from .pool import (
+    INITIAL_ELO,
+    LEAGUE_FILENAME,
+    LIVE_ID,
+    LeaguePool,
+    elo_expected,
+    fit_elo,
+    pairwise_win_fraction,
+)
+
+__all__ = [
+    "INITIAL_ELO",
+    "LEAGUE_FILENAME",
+    "LIVE_ID",
+    "FlywheelLoop",
+    "LeaguePool",
+    "Matchmaker",
+    "TrajectoryEmitter",
+    "apply_staleness_guard",
+    "elo_expected",
+    "fit_elo",
+    "merge_results",
+    "pairwise_win_fraction",
+    "run_flywheel",
+]
+
+
+def __getattr__(name):
+    # flywheel imports jax/training at module load; keep the light
+    # pieces (pool/matchmaker/emitter math) importable without it.
+    if name in ("FlywheelLoop", "run_flywheel"):
+        from . import flywheel
+
+        return getattr(flywheel, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

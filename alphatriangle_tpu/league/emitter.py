@@ -1,0 +1,242 @@
+"""Trajectory emitter: served games become replay-ready harvests.
+
+The RLAX loop shape (arXiv:2512.06392): actors stream staleness-tagged
+trajectories into the learner's replay path while the learner
+broadcasts params back on the step clock. Here the "actor" is the
+policy service — every move served through `PolicyService.dispatch`
+can be harvested as a `(state features, visit-count policy, outcome)`
+row in exactly the layout `ring_scatter`/`add_dense` ingests, tagged
+with the hot-reload counter (`PolicyService.weight_reloads`) of the
+params that played it.
+
+The emitter is pluggable and off by default: a service without one
+behaves byte-for-byte as before (eval, arena, `cli serve` human
+traffic), and attaching one makes any serve client a data source.
+Completed sessions are packaged as `SelfPlayResult` so the training
+loop's `_fold_result` seam — buffer ingest with max-priority PER init,
+staleness metrics, telemetry — works unchanged on served data.
+"""
+
+import logging
+
+import numpy as np
+
+from ..mcts.helpers import policy_target_from_visits
+from ..rl.types import SelfPlayResult
+
+logger = logging.getLogger(__name__)
+
+_stale_warned = False
+
+
+class TrajectoryEmitter:
+    """Harvests per-move rows from a `PolicyService`'s dispatches.
+
+    Wire by assigning to `service.emitter`; the service calls
+    `on_dispatch` once per batched dispatch (pre-step states + search
+    output + post-step rewards) and `on_session_close` when a session
+    retires. Finished trajectories accumulate until `drain()` (or flow
+    to `sink`, when given, as one `SelfPlayResult` per episode)."""
+
+    def __init__(
+        self,
+        env,
+        extractor,
+        use_gumbel: bool = False,
+        gamma: float = 1.0,
+        sink=None,
+    ):
+        self.env = env
+        self.extractor = extractor
+        self.use_gumbel = bool(use_gumbel)
+        self.gamma = float(gamma)
+        self.sink = sink
+        # sid -> per-move row lists (grid/other/policy/reward/version).
+        self._open: dict[int, dict] = {}
+        self._done: list[SelfPlayResult] = []
+        self.moves_emitted = 0
+        self.episodes_emitted = 0
+
+    # --- service hooks ----------------------------------------------------
+
+    def on_dispatch(
+        self, states, out, served, rewards_np, dones_np, version: int
+    ) -> None:
+        """One batched dispatch: `states` are the PRE-step session
+        states (the positions the search ran on), `served` the Session
+        handles served, `version` the service's hot-reload counter —
+        the staleness tag every row of this dispatch carries."""
+        grids, others = self.extractor.extract_batch(states)
+        if self.use_gumbel and getattr(out, "improved_policy", None) is not None:
+            policy = out.improved_policy
+        else:
+            policy = policy_target_from_visits(
+                out.visit_counts, self.env.valid_mask_batch(states)
+            )
+        grids = np.asarray(grids, dtype=np.float32)
+        others = np.asarray(others, dtype=np.float32)
+        policy = np.asarray(policy, dtype=np.float32)
+        for s in served:
+            rows = self._open.setdefault(
+                s.sid,
+                {
+                    "grid": [],
+                    "other": [],
+                    "policy": [],
+                    "reward": [],
+                    "version": [],
+                },
+            )
+            rows["grid"].append(grids[s.slot])
+            rows["other"].append(others[s.slot])
+            rows["policy"].append(policy[s.slot])
+            rows["reward"].append(float(rewards_np[s.slot]))
+            rows["version"].append(int(version))
+
+    def on_session_close(self, sid: int, summary: dict) -> None:
+        """Session retired: fold its moves into one episode harvest.
+        Value targets are discounted Monte-Carlo outcome returns —
+        ret[t] = sum_k gamma^k r[t+k] — the "(features, policy,
+        outcome)" tuple of the flywheel contract."""
+        rows = self._open.pop(sid, None)
+        if not rows or not rows["grid"]:
+            return
+        rewards = np.asarray(rows["reward"], dtype=np.float32)
+        returns = np.empty_like(rewards)
+        acc = 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            acc = rewards[t] + self.gamma * acc
+            returns[t] = acc
+        result = SelfPlayResult(
+            grid=np.stack(rows["grid"]).astype(np.float32),
+            other_features=np.stack(rows["other"]),
+            policy_target=np.stack(rows["policy"]),
+            value_target=returns,
+            episode_scores=[float(summary.get("score", 0.0))],
+            episode_lengths=[len(rewards)],
+            episode_start_versions=[rows["version"][0]],
+            num_episodes=1,
+            num_truncated=0 if summary.get("done") else 1,
+            trainer_step_at_episode_start=rows["version"][0],
+            context={
+                "source": "league",
+                "row_versions": list(rows["version"]),
+            },
+        )
+        self.episodes_emitted += 1
+        self.moves_emitted += result.num_experiences
+        if self.sink is not None:
+            self.sink(result)
+        else:
+            self._done.append(result)
+
+    # --- harvest ----------------------------------------------------------
+
+    def drain(self) -> "SelfPlayResult | None":
+        """All finished episodes since the last drain, merged into one
+        dense harvest (None when nothing finished)."""
+        results, self._done = self._done, []
+        return merge_results(results)
+
+
+def merge_results(results: list) -> "SelfPlayResult | None":
+    """Concatenate per-episode harvests into one dense block."""
+    results = [r for r in results if r is not None and r.num_experiences]
+    if not results:
+        return None
+    return SelfPlayResult(
+        grid=np.concatenate([r.grid for r in results]),
+        other_features=np.concatenate([r.other_features for r in results]),
+        policy_target=np.concatenate([r.policy_target for r in results]),
+        value_target=np.concatenate([r.value_target for r in results]),
+        policy_weight=np.concatenate([r.policy_weight for r in results]),
+        episode_scores=[s for r in results for s in r.episode_scores],
+        episode_lengths=[x for r in results for x in r.episode_lengths],
+        episode_start_versions=[
+            v for r in results for v in r.episode_start_versions
+        ],
+        num_episodes=sum(r.num_episodes for r in results),
+        num_truncated=sum(r.num_truncated for r in results),
+        total_simulations=sum(r.total_simulations for r in results),
+        trainer_step_at_episode_start=min(
+            r.trainer_step_at_episode_start for r in results
+        ),
+        context={
+            "source": "league",
+            "row_versions": [
+                v
+                for r in results
+                for v in r.context.get(
+                    "row_versions",
+                    [r.trainer_step_at_episode_start] * r.num_experiences,
+                )
+            ],
+        },
+    )
+
+
+def apply_staleness_guard(
+    result: "SelfPlayResult | None", clock: int, window: int
+) -> "tuple[SelfPlayResult | None, int]":
+    """Drop rows whose params version trails `clock` by more than
+    `window` reloads: (kept result or None, dropped count).
+
+    The actor-lag guard of the RLAX loop — a session that kept playing
+    across many weight broadcasts emits late moves fresh and early
+    moves stale; only the stale rows are dropped. Warns once (the
+    non-finite drop-counter idiom, rl/device_buffer.py); the cumulative
+    count rides the `Stats/stale_dropped` metric and the league ledger
+    records."""
+    global _stale_warned
+    if result is None or window is None or window < 0:
+        return result, 0
+    versions = np.asarray(
+        result.context.get(
+            "row_versions",
+            [result.trainer_step_at_episode_start] * result.num_experiences,
+        ),
+        dtype=np.int64,
+    )
+    if versions.shape[0] != result.num_experiences:
+        # Row/version desync (validator dropped rows): keep everything
+        # rather than guess an alignment.
+        return result, 0
+    keep = (int(clock) - versions) <= int(window)
+    dropped = int((~keep).sum())
+    if dropped == 0:
+        return result, 0
+    if not _stale_warned:
+        _stale_warned = True
+        logger.warning(
+            "Staleness guard: dropping %d of %d league rows more than "
+            "%d reloads behind the learner (warn-once; see "
+            "Stats/stale_dropped).",
+            dropped,
+            result.num_experiences,
+            window,
+        )
+    if keep.sum() == 0:
+        return None, dropped
+    kept = SelfPlayResult(
+        grid=result.grid[keep],
+        other_features=result.other_features[keep],
+        policy_target=result.policy_target[keep],
+        value_target=result.value_target[keep],
+        policy_weight=(
+            result.policy_weight[keep]
+            if result.policy_weight is not None
+            else None
+        ),
+        episode_scores=result.episode_scores,
+        episode_lengths=result.episode_lengths,
+        episode_start_versions=result.episode_start_versions,
+        num_episodes=result.num_episodes,
+        num_truncated=result.num_truncated,
+        total_simulations=result.total_simulations,
+        trainer_step_at_episode_start=result.trainer_step_at_episode_start,
+        context={
+            **result.context,
+            "row_versions": versions[keep].tolist(),
+        },
+    )
+    return kept, dropped

@@ -94,6 +94,10 @@ class PolicyService:
         # Flight recorder rides the run telemetry (telemetry/flight.py);
         # None when serving without telemetry (tests, warm-only paths).
         self.flight = getattr(telemetry, "flight", None)
+        # Optional trajectory sink (league/emitter.py): when set, every
+        # dispatch hands it the pre-step states + search output so
+        # served games become training data. None = serve-only.
+        self.emitter = None
         self._clock = clock
         self.sessions = SessionSlots(env, slots, pad_seed=pad_seed)
         # The serve program: the search jit wrapped for AOT executable
@@ -198,6 +202,13 @@ class PolicyService:
             summary = self.sessions.retire(sid)
             if sid in self._queue:
                 self._queue.remove(sid)
+            if self.emitter is not None:
+                try:
+                    self.emitter.on_session_close(sid, summary)
+                except Exception:
+                    logger.exception(
+                        "trajectory emitter failed closing session %d", sid
+                    )
             return summary
 
     def request_move(self, sid: int) -> None:
@@ -260,6 +271,9 @@ class PolicyService:
                     self._serve_variables(), self.sessions.states, rng
                 )
                 actions = select_root_actions(out, self.use_gumbel)
+                # The positions the search ran on; the pytree stays
+                # valid after step() installs the successor states.
+                pre_states = self.sessions.states
                 rewards, dones = self.sessions.step(actions, mask)
                 # Response materialization: the host sync IS the
                 # product here (clients need their move), one fetch
@@ -268,6 +282,23 @@ class PolicyService:
                 dones_np = np.asarray(dones)
                 scores_np = np.asarray(self.sessions.states.score)
             t1 = self._clock()
+
+            if self.emitter is not None:
+                try:
+                    self.emitter.on_dispatch(
+                        pre_states,
+                        out,
+                        served,
+                        rewards_np,
+                        dones_np,
+                        self.weight_reloads,
+                    )
+                except Exception:
+                    logger.exception(
+                        "trajectory emitter failed on dispatch %d; "
+                        "serving continues",
+                        self.dispatch_count,
+                    )
 
             batch_ms = (t1 - t0) * 1e3
             results = []

@@ -54,6 +54,10 @@ COMPARE_METRICS = (
     "memory_budget_bytes",
     "serve_move_latency_ms_p95",
     "serve_requests_per_sec",
+    # League flywheel (league/flywheel.py): how fast served games turn
+    # into replay rows. Only flywheel runs carry it (rows compare only
+    # when both sides have the metric, like the serve SLOs).
+    "league_ingested_moves_per_sec",
 )
 
 # Metrics where a LOWER candidate value is the good direction.
@@ -435,6 +439,44 @@ def summarize_utilization(
     }
 
 
+def summarize_league(records: list) -> "dict | None":
+    """Fold a run's `kind:"league"` records (league/flywheel.py, one
+    per matchmade round) into the league block of the `cli perf`
+    summary: pool size, ingest volume/rate, opponent-mix histogram,
+    mean trajectory staleness, promotions. None for non-flywheel runs
+    (no league records), so the block and the compare row only appear
+    where the flywheel ran."""
+    league = [
+        r for r in records if isinstance(r, dict) and r.get("kind") == "league"
+    ]
+    if not league:
+        return None
+    last = league[-1]
+
+    def numeric(key: str) -> list:
+        return [
+            r.get(key)
+            for r in league
+            if isinstance(r.get(key), (int, float))
+            and not isinstance(r.get(key), bool)
+        ]
+
+    moves = numeric("moves_ingested")
+    return {
+        "league_rounds": len(league),
+        "league_pool_size": last.get("pool_size"),
+        "league_moves_ingested": int(sum(moves)) if moves else None,
+        "league_ingested_moves_per_sec": _mean(
+            numeric("ingested_moves_per_sec")
+        ),
+        "league_mean_staleness": _mean(numeric("mean_staleness")),
+        "league_stale_dropped": last.get("stale_dropped_total"),
+        "league_promotions": last.get("promotions"),
+        "league_live_elo": last.get("live_elo"),
+        "league_opponent_mix": last.get("opponent_mix"),
+    }
+
+
 # --- cross-run comparison ----------------------------------------------
 
 
@@ -506,6 +548,11 @@ def load_comparable(
         budget = compose_budget(mem_records)
         if budget["total_bytes"] > 0:
             summary["memory_budget_bytes"] = budget["total_bytes"]
+    # League flywheel fold: flywheel runs gain the league_* fields
+    # (and with them the league_ingested_moves_per_sec compare row).
+    league = summarize_league(read_ledger(ledger, kinds={"league"}))
+    if league is not None:
+        summary.update(league)
     summary["source"] = str(ledger)
     return summary, str(ledger)
 

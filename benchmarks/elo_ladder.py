@@ -127,6 +127,10 @@ def main() -> None:
             service, args.games, args.max_moves, args.seed
         )
 
+    # Win-rate matrix + Elo fit via the league subsystem's shared
+    # rating math (league/pool.py) — the ladder is a thin client of it.
+    from alphatriangle_tpu.league import fit_elo, pairwise_win_fraction
+
     n = len(steps)
     wins = np.zeros((n, n))
     # Clip away 0/1 winrates: the Bradley-Terry MLE is unbounded for a
@@ -137,18 +141,12 @@ def main() -> None:
         for j, b in enumerate(steps):
             if i == j:
                 continue
-            d = scores[a] - scores[b]
-            w = (d > 0).mean() + 0.5 * (d == 0).mean()
+            # paired=True: both rungs played the SAME hands, so the
+            # element-wise comparison cancels hand luck.
+            w = pairwise_win_fraction(scores[a], scores[b], paired=True)
             wins[i, j] = min(max(w, eps), 1.0 - eps)
 
-    # Elo fit: iterative logistic (Bradley-Terry in Elo units).
-    elo = np.zeros(n)
-    for _ in range(200):
-        expected = 1.0 / (1.0 + 10 ** ((elo[None, :] - elo[:, None]) / 400.0))
-        np.fill_diagonal(expected, 0.0)
-        grad = (wins - expected).sum(axis=1)
-        elo += 8.0 * grad
-        elo -= elo.mean()
+    elo = fit_elo(wins)
 
     table = [
         {

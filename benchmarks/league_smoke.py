@@ -1,0 +1,296 @@
+"""CI league-smoke gate: the experience flywheel end to end on CPU.
+
+`make league-smoke` runs this. On a machine with no accelerator it
+proves the league subsystem (docs/LEAGUE.md) still closes the loop:
+
+1. a tiny CPU training run (`perf_smoke`-sized world) leaves >=2
+   checkpoints — the seed population;
+2. `cli league --pool-from <that run>` runs the flywheel: the learner
+   trains while a PolicyService plays matchmade games against the
+   pool, served trajectories flow into the replay ring interleaved
+   with self-play, and a permissive promotion gate lets the live net
+   earn at least one pool seat;
+3. the flywheel run's `league.jsonl` replays cleanly and its rating
+   events are monotonically consistent with its result events (the
+   incremental Elo fold reproduces every persisted rating);
+4. the run's ledger carries `kind: "league"` records proving
+   service-played moves actually reached the ring (moves_ingested,
+   buffer growth, staleness tags);
+5. `cli perf --json` summarizes the league fields and `cli compare
+   --metrics league_ingested_moves_per_sec` aligns them;
+6. the flywheel run's checkpoint resumes under plain training — a
+   flywheel run is an ordinary run that also served games.
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise.
+"""
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SRC_RUN = "league_smoke_src"
+FLY_RUN = "league_smoke_fly"
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+# Must precede any jax import: the smoke must not wake an accelerator.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fail(msg: str, code: int = 2) -> int:
+    print(f"league-smoke: {msg}", file=sys.stderr)
+    return code
+
+
+def check_rating_consistency(league_path: Path) -> "str | None":
+    """Replay league.jsonl's result events through the incremental Elo
+    fold and require every persisted rating event to match, in order —
+    the monotonic-consistency gate on the crash-safe store."""
+    from alphatriangle_tpu.league import LeaguePool
+
+    shadow = LeaguePool(league_path.parent / "_shadow.jsonl")
+    checked = 0
+    for line in league_path.read_text().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail tolerance, same as the reader
+        kind = r.get("kind")
+        if kind == "member":
+            shadow.add_member(
+                r["member_id"], r.get("checkpoint", ""), r.get("step") or 0,
+                elo=float(r.get("elo", 0.0)),
+            )
+        elif kind == "result":
+            shadow._fold_result(r["a"], r["b"], float(r["score_a"]), persist=False)
+        elif kind == "rating":
+            got = shadow.ratings.get(r["member_id"])
+            if got is None or abs(got - float(r["elo"])) > 1e-2:
+                return (
+                    f"rating event for {r['member_id']} says {r['elo']} "
+                    f"but the result replay gives {got}"
+                )
+            checked += 1
+    if checked == 0:
+        return "no rating events to check"
+    print(f"league-smoke: {checked} rating event(s) replay-consistent")
+    return None
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--root-dir",
+        default=None,
+        help="Runs root for the smoke runs (default: a temp dir).",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from alphatriangle_tpu.cli import main as cli_main
+    from alphatriangle_tpu.config import PersistenceConfig
+    from alphatriangle_tpu.league import LEAGUE_FILENAME
+    from alphatriangle_tpu.training import run_training
+
+    # The seed run reuses the perf smoke's tiny world so both smokes
+    # exercise the same geometry.
+    from benchmarks.perf_smoke import tiny_configs
+
+    root = args.root_dir or tempfile.mkdtemp(prefix="at_league_smoke_")
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_configs()
+    train_cfg = train_cfg.model_copy(update={"RUN_NAME": SRC_RUN})
+    src_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=SRC_RUN)
+
+    print(f"league-smoke: seeding pool run {SRC_RUN} under {root}...", flush=True)
+    rc = run_training(
+        train_config=train_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=src_pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        return fail(f"seed training run failed (rc={rc})", rc)
+
+    from alphatriangle_tpu.stats.persistence import CheckpointManager
+
+    mgr = CheckpointManager(src_pc)
+    steps = mgr.list_steps()
+    mgr.close()
+    if len(steps) < 2:
+        return fail(f"seed run left {steps} checkpoint(s); need >=2")
+    print(f"league-smoke: seed checkpoints at steps {steps}")
+
+    print("league-smoke: flywheel run (cli league)...", flush=True)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(
+            [
+                "league",
+                "--pool-from", SRC_RUN,
+                "--root-dir", root,
+                "--run-name", FLY_RUN,
+                "--steps", "6",
+                "--seed", "5",
+                "--device", "cpu",
+                "--sims", "4",
+                "--self-play-batch", "4",
+                "--batch-size", "8",
+                "--buffer-capacity", "2000",
+                "--min-buffer", "16",
+                "--rollout-chunk", "4",
+                "--checkpoint-freq", "2",
+                "--max-moves", "20",
+                "--slots", "4",
+                "--games", "2",
+                "--mix", "0.5",
+                "--reload-every", "1",
+                # Permissive gate: the smoke proves the promotion
+                # machinery, not playing strength.
+                "--promotion-games", "1",
+                "--promotion-win-rate", "0.0",
+            ]
+        )
+    sys.stdout.write(buf.getvalue())
+    if rc != 0:
+        return fail(f"cli league failed (rc={rc})", rc)
+    report_lines = [
+        ln for ln in buf.getvalue().splitlines() if ln.startswith("{")
+    ]
+    if not report_lines:
+        return fail("cli league printed no JSON report line")
+    report = json.loads(report_lines[-1])
+    print(f"league-smoke: report {report}")
+
+    fly_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=FLY_RUN)
+    league_path = fly_pc.get_run_base_dir() / LEAGUE_FILENAME
+    if not league_path.exists():
+        return fail(f"{league_path} missing")
+    if report.get("pool_size", 0) < 2:
+        return fail(f"pool has {report.get('pool_size')} member(s); expected >=2")
+    if report.get("promotions", 0) < 1:
+        return fail("no promotion happened under the permissive gate")
+    err = check_rating_consistency(league_path)
+    if err:
+        return fail(f"league.jsonl inconsistent: {err}")
+
+    print("league-smoke: ledger league records...", flush=True)
+    ledger = fly_pc.get_run_base_dir() / "metrics.jsonl"
+    league_records = []
+    for line in ledger.read_text().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if r.get("kind") == "league":
+            league_records.append(r)
+    if not league_records:
+        return fail(f"{ledger} has no kind='league' records")
+    ingested = sum(int(r.get("moves_ingested", 0)) for r in league_records)
+    grew = any(
+        r.get("buffer_size_after", 0) > r.get("buffer_size_before", 0)
+        for r in league_records
+    )
+    tagged = any(
+        isinstance(r.get("mean_staleness"), (int, float))
+        for r in league_records
+    )
+    if ingested <= 0 or not grew:
+        return fail(
+            f"league records show {ingested} ingested move(s), "
+            f"buffer growth={grew} — served trajectories never reached "
+            "the replay ring"
+        )
+    if not tagged:
+        return fail("no league record carries a mean_staleness tag")
+    print(
+        f"league-smoke: {len(league_records)} round(s), {ingested} "
+        f"service-played move(s) into the ring, staleness tags present"
+    )
+
+    print("league-smoke: cli perf --json league fields...", flush=True)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["perf", FLY_RUN, "--root-dir", root, "--json"])
+    if rc != 0:
+        return fail(f"cli perf failed (rc={rc})", rc)
+    summary = json.loads(buf.getvalue())
+    for key in (
+        "league_rounds",
+        "league_pool_size",
+        "league_moves_ingested",
+        "league_ingested_moves_per_sec",
+        "league_promotions",
+    ):
+        if key not in summary:
+            return fail(f"cli perf --json summary lacks {key}")
+    print(
+        f"league-smoke: perf summary rounds={summary['league_rounds']} "
+        f"ingest={summary['league_ingested_moves_per_sec']} moves/s"
+    )
+
+    print("league-smoke: cli compare league metric alignment...", flush=True)
+    snapshot = Path(root) / "league_smoke_reference.json"
+    summary["source"] = "benchmarks/league_smoke.py"
+    snapshot.write_text(json.dumps(summary, indent=2))
+    rc = cli_main(
+        [
+            "compare",
+            FLY_RUN,
+            str(snapshot),
+            "--root-dir", root,
+            "--metrics", "league_ingested_moves_per_sec",
+            "--threshold", "0.9",
+        ]
+    )
+    if rc != 0:
+        return fail(f"cli compare on the league metric failed (rc={rc})", rc)
+
+    print("league-smoke: flywheel checkpoint resumes under plain train...", flush=True)
+    # A flywheel run is an ordinary run: its checkpoint (weights +
+    # counters + mixed-source replay spill) must restore under the
+    # standard training entrypoint and keep stepping.
+    resume_cfg = train_cfg.model_copy(
+        update={"RUN_NAME": FLY_RUN, "MAX_TRAINING_STEPS": 8}
+    )
+    rc = run_training(
+        train_config=resume_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=fly_pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        return fail(f"plain-train resume of {FLY_RUN} failed (rc={rc})", rc)
+    mgr = CheckpointManager(fly_pc)
+    final = mgr.latest_step()
+    mgr.close()
+    if final is None or final < 8:
+        return fail(f"resume ended at step {final}; expected >=8")
+    print(f"league-smoke: resumed to step {final}")
+
+    if args.root_dir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    print("league-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,486 @@
+"""League subsystem (alphatriangle_tpu/league/): pool persistence +
+Elo consistency, matchmaking distribution, the trajectory emitter on a
+real PolicyService (staleness tags, flight-family pinning), the
+staleness guard, and source-agnostic replay-ring ingest of an
+externally-built harvest (PER max-priority init, spill interchange)."""
+
+import json
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from alphatriangle_tpu.env.engine import TriangleEnv
+from alphatriangle_tpu.features.core import get_feature_extractor
+from alphatriangle_tpu.league import (
+    LIVE_ID,
+    LeaguePool,
+    Matchmaker,
+    TrajectoryEmitter,
+    apply_staleness_guard,
+    fit_elo,
+    pairwise_win_fraction,
+)
+from alphatriangle_tpu.mcts import BatchedMCTS
+from alphatriangle_tpu.nn.network import NeuralNetwork
+from alphatriangle_tpu.serving import PolicyService, serve_program_name
+
+SLOTS = 6
+
+
+@pytest.fixture(scope="module")
+def league_world(tiny_env_config, tiny_model_config):
+    from alphatriangle_tpu.config import AlphaTriangleMCTSConfig
+
+    env = TriangleEnv(tiny_env_config)
+    fe = get_feature_extractor(env, tiny_model_config)
+    net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=3)
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=4, max_depth=3, mcts_batch_size=4
+    )
+    mcts = BatchedMCTS(env, fe, net.model, mcts_cfg, net.support)
+    return env, fe, net, mcts
+
+
+def make_service(league_world, **kw):
+    env, fe, net, mcts = league_world
+    return PolicyService(env, fe, net, mcts, slots=SLOTS, **kw)
+
+
+class TestPoolRatings:
+    def test_elo_update_direction_and_zero_sum(self, tmp_path):
+        pool = LeaguePool(tmp_path / "league.jsonl")
+        pool.add_member("a", "/ckpt/a", 1)
+        ra, rb = pool.record_result(LIVE_ID, "a", 1.0)
+        assert ra > 0 > rb  # winner up, loser down from 0/0
+        assert ra + rb == pytest.approx(0.0)  # K-factor update is zero-sum
+        # A loss moves them back toward each other.
+        ra2, rb2 = pool.record_result(LIVE_ID, "a", 0.0)
+        assert ra2 < ra and rb2 > rb
+
+    def test_replay_reconstructs_state_crash_safe(self, tmp_path):
+        path = tmp_path / "league.jsonl"
+        pool = LeaguePool(path)
+        pool.add_member("a", "/ckpt/a", 1)
+        pool.add_member("b", "/ckpt/b", 2)
+        pool.record_result(LIVE_ID, "a", 0.75)
+        pool.record_result(LIVE_ID, "b", 0.25)
+        pool.maybe_promote("/ckpt/live", 5, min_games=2, win_rate_gate=0.4)
+        # Torn tail: a crashed writer's partial line must not poison
+        # the replay (the MetricsLedger read contract).
+        with path.open("a") as f:
+            f.write('{"kind": "resu')
+        fresh = LeaguePool(path)
+        assert fresh.member_ids() == pool.member_ids()
+        for m in [LIVE_ID, *pool.member_ids()]:
+            assert fresh.rating(m) == pytest.approx(pool.rating(m))
+        assert fresh.promotions == pool.promotions == 1
+        assert fresh.games == pool.games
+
+    def test_ratings_monotonically_consistent_with_results(self, tmp_path):
+        """The smoke's gate, as a property: replaying league.jsonl's
+        result events through the incremental update reproduces the
+        persisted rating events exactly, in order."""
+        pool = LeaguePool(tmp_path / "league.jsonl", elo_k=24.0)
+        pool.add_member("a", "/ckpt/a", 1)
+        pool.add_member("b", "/ckpt/b", 2)
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            pool.record_result(
+                LIVE_ID, ["a", "b"][rng.integers(2)], float(rng.random())
+            )
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "league.jsonl").read_text().splitlines()
+        ]
+        shadow = LeaguePool(tmp_path / "empty.jsonl", elo_k=24.0)
+        for r in records:
+            if r["kind"] == "result":
+                shadow._fold_result(r["a"], r["b"], r["score_a"], persist=False)
+            elif r["kind"] == "rating":
+                assert shadow.ratings[r["member_id"]] == pytest.approx(
+                    r["elo"], abs=1e-3
+                )
+
+    def test_promotion_gate_and_window_reset(self, tmp_path):
+        pool = LeaguePool(tmp_path / "league.jsonl")
+        pool.add_member("a", "/ckpt/a", 1)
+        pool.record_result(LIVE_ID, "a", 1.0)
+        # Not enough games yet.
+        assert pool.maybe_promote("/c", 7, min_games=2, win_rate_gate=0.6) is None
+        pool.record_result(LIVE_ID, "a", 0.9)
+        member = pool.maybe_promote("/c", 7, min_games=2, win_rate_gate=0.6)
+        assert member == "step_00000007"
+        assert member in pool.members
+        # Promotion seeds the member at the live rating and resets the
+        # live evidence window.
+        assert pool.rating(member) == pytest.approx(pool.rating(LIVE_ID))
+        assert pool.games[LIVE_ID] == 0 and pool.win_rate(LIVE_ID) is None
+        # Same step never promotes twice.
+        pool.record_result(LIVE_ID, "a", 1.0)
+        pool.record_result(LIVE_ID, "a", 1.0)
+        assert pool.maybe_promote("/c", 7, min_games=2, win_rate_gate=0.6) is None
+
+    def test_losing_live_net_never_promotes(self, tmp_path):
+        pool = LeaguePool(tmp_path / "league.jsonl")
+        pool.add_member("a", "/ckpt/a", 1)
+        for _ in range(5):
+            pool.record_result(LIVE_ID, "a", 0.2)
+        assert pool.maybe_promote("/c", 9, min_games=2, win_rate_gate=0.55) is None
+        assert pool.promotions == 0
+
+    def test_fit_elo_ranks_dominance(self):
+        # a beats b beats c (clipped winrates) -> elo order a > b > c.
+        wins = np.array(
+            [[0.0, 0.8, 0.9], [0.2, 0.0, 0.8], [0.1, 0.2, 0.0]]
+        )
+        elo = fit_elo(wins)
+        assert elo[0] > elo[1] > elo[2]
+        assert elo.mean() == pytest.approx(0.0)
+
+    def test_pairwise_win_fraction_modes(self):
+        a, b = [2.0, 0.0], [1.0, 1.0]
+        # Paired: (2>1)=win, (0<1)=loss -> 0.5. Cross: 2 beats both,
+        # 0 loses both -> 0.5 too; asymmetric sample splits them.
+        assert pairwise_win_fraction(a, b, paired=True) == pytest.approx(0.5)
+        assert pairwise_win_fraction([3.0], [1.0, 2.0]) == pytest.approx(1.0)
+        assert pairwise_win_fraction([], [1.0]) == pytest.approx(0.5)
+
+
+class TestMatchmaker:
+    def _pool(self, tmp_path, ratings):
+        pool = LeaguePool(tmp_path / "league.jsonl")
+        for i, (mid, elo) in enumerate(ratings.items()):
+            pool.add_member(mid, f"/ckpt/{mid}", i, elo=elo)
+        return pool
+
+    def test_probabilities_floor_and_proximity(self, tmp_path):
+        pool = self._pool(
+            tmp_path, {"near": 10.0, "mid": 300.0, "far": 1500.0}
+        )
+        mm = Matchmaker(pool, temperature=200.0, exploration_floor=0.15)
+        probs = mm.probabilities(live_rating=0.0)
+        assert sum(probs.values()) == pytest.approx(1.0)
+        assert probs["near"] > probs["mid"] > probs["far"]
+        # Exploration floor: even the 1500-gap member keeps at least
+        # floor/N mass (KataGo-style anti-starvation).
+        assert probs["far"] >= 0.15 / 3 - 1e-12
+
+    def test_sampling_histogram_tracks_distribution(self, tmp_path):
+        pool = self._pool(tmp_path, {"near": 0.0, "far": 2000.0})
+        mm = Matchmaker(pool, temperature=100.0, exploration_floor=0.2, seed=5)
+        for _ in range(200):
+            mm.sample_opponent(live_rating=0.0)
+        mix = mm.opponent_mix()
+        assert mix["near"] + mix["far"] == 200
+        # near gets ~0.8+0.1, far ~0.1 of the mass.
+        assert mix["near"] > mix["far"]
+        assert mix["far"] > 0  # the floor keeps it in rotation
+
+    def test_empty_pool_raises(self, tmp_path):
+        pool = LeaguePool(tmp_path / "league.jsonl")
+        mm = Matchmaker(pool)
+        with pytest.raises(RuntimeError, match="empty"):
+            mm.sample_opponent()
+
+
+@pytest.mark.slow
+class TestTrajectoryEmitter:
+    """Service-driving coverage (builds a net + MCTS, plays real games
+    through PolicyService) — excluded from the tier-1 wall-time budget
+    like the megastep smokes; `make league-smoke` drives the same
+    machinery end to end in CI."""
+    def test_harvest_rows_match_play(self, league_world):
+        """Drive one session move by move; the drained harvest must
+        carry one row per move with the dispatch rewards discounted
+        into value targets and normalized policy targets."""
+        env, fe, net, mcts = league_world
+        service = make_service(league_world)
+        emitter = TrajectoryEmitter(env, fe, gamma=0.5)
+        service.emitter = emitter
+        s = service.open_session(jax.random.PRNGKey(0))
+        rewards, moves = [], 0
+        for i in range(12):
+            service.request_move(s.sid)
+            (r,) = service.dispatch(rng=jax.random.PRNGKey(100 + i))
+            rewards.append(r["reward"])
+            moves += 1
+            if r["done"]:
+                break
+        service.close_session(s.sid)
+        result = emitter.drain()
+        assert result is not None and result.num_experiences == moves
+        assert emitter.episodes_emitted == 1
+        assert result.context["source"] == "league"
+        # Per-row staleness tags: no reloads happened -> all 0.
+        assert result.context["row_versions"] == [0] * moves
+        # Discounted MC returns over the exact served rewards.
+        expected = np.zeros(moves, dtype=np.float32)
+        acc = 0.0
+        for t in range(moves - 1, -1, -1):
+            acc = rewards[t] + 0.5 * acc
+            expected[t] = acc
+        np.testing.assert_allclose(result.value_target, expected, rtol=1e-5)
+        # Policy targets are distributions in the ingest layout.
+        np.testing.assert_allclose(
+            result.policy_target.sum(axis=1), 1.0, atol=1e-4
+        )
+        grids, others = fe.extract_batch(service.sessions.states)
+        assert result.grid.shape[1:] == np.asarray(grids).shape[1:]
+        assert result.other_features.shape[1] == np.asarray(others).shape[1]
+
+    def test_staleness_tags_follow_weight_reloads(self, league_world):
+        env, fe, net, mcts = league_world
+        service = make_service(league_world)
+        emitter = TrajectoryEmitter(env, fe)
+        service.emitter = emitter
+        s = service.open_session(jax.random.PRNGKey(1))
+        service.request_move(s.sid)
+        service.dispatch(rng=jax.random.PRNGKey(0))
+        service.reload_weights()  # the hot-reload counter ticks
+        service.request_move(s.sid)
+        service.dispatch(rng=jax.random.PRNGKey(1))
+        service.close_session(s.sid)
+        result = emitter.drain()
+        assert result.context["row_versions"] == [0, 1]
+        assert result.episode_start_versions == [0]
+
+    def test_emitter_off_by_default_and_sink(self, league_world):
+        env, fe, net, mcts = league_world
+        service = make_service(league_world)
+        assert service.emitter is None  # serve-only behavior preserved
+        seen = []
+        emitter = TrajectoryEmitter(env, fe, sink=seen.append)
+        service.emitter = emitter
+        s = service.open_session(jax.random.PRNGKey(2))
+        service.request_move(s.sid)
+        service.dispatch(rng=jax.random.PRNGKey(0))
+        service.close_session(s.sid)
+        assert len(seen) == 1 and seen[0].num_experiences == 1
+        assert emitter.drain() is None  # sink consumed it
+
+    def test_league_play_reuses_serve_flight_family(
+        self, league_world, tmp_path
+    ):
+        """Satellite pin: league games through the service seal
+        `serve/b<B>` flight records — `cli doctor` postmortems and
+        `cli watch`'s dispatch line work unchanged in flywheel runs."""
+        from alphatriangle_tpu.arena import play_service
+        from alphatriangle_tpu.telemetry.flight import (
+            FlightRecorder,
+            read_flight,
+        )
+
+        env, fe, net, mcts = league_world
+        service = make_service(league_world)
+        service.flight = FlightRecorder(tmp_path / "flight.jsonl")
+        service.emitter = TrajectoryEmitter(env, fe)
+        play_service(service, games=2, max_moves=4, seed=11)
+        seals = [
+            r
+            for r in read_flight(tmp_path / "flight.jsonl")
+            if r.get("phase") == "seal"
+        ]
+        assert seals, "league dispatches must seal flight records"
+        assert {r["family"] for r in seals} == {"serve"}
+        assert {r["program"] for r in seals} == {serve_program_name(SLOTS)}
+        assert all(r["ok"] for r in seals)
+
+
+class TestStalenessGuard:
+    def _harvest(self, versions, n_actions=12):
+        from alphatriangle_tpu.rl.types import SelfPlayResult
+
+        n = len(versions)
+        policy = np.full((n, n_actions), 1.0 / n_actions, np.float32)
+        return SelfPlayResult(
+            grid=np.zeros((n, 1, 3, 4), np.float32),
+            other_features=np.zeros((n, 5), np.float32),
+            policy_target=policy,
+            value_target=np.arange(n, dtype=np.float32),
+            episode_scores=[1.0],
+            episode_lengths=[n],
+            episode_start_versions=[versions[0]],
+            num_episodes=1,
+            context={"source": "league", "row_versions": list(versions)},
+        )
+
+    def test_fresh_rows_pass_untouched(self):
+        result = self._harvest([5, 5, 6])
+        kept, dropped = apply_staleness_guard(result, clock=6, window=2)
+        assert kept is result and dropped == 0
+
+    def test_stale_rows_drop_and_count(self, caplog):
+        import alphatriangle_tpu.league.emitter as emitter_mod
+
+        emitter_mod._stale_warned = False
+        result = self._harvest([0, 1, 7, 8])
+        with caplog.at_level(logging.WARNING):
+            kept, dropped = apply_staleness_guard(result, clock=9, window=3)
+        assert dropped == 2
+        assert kept.num_experiences == 2
+        # Only the fresh rows' tags and value targets survive, aligned.
+        assert kept.context["row_versions"] == [7, 8]
+        np.testing.assert_array_equal(kept.value_target, [2.0, 3.0])
+        assert any("Staleness guard" in r.message for r in caplog.records)
+        # Warn-once: a second guarded drop stays quiet.
+        caplog.clear()
+        with caplog.at_level(logging.WARNING):
+            apply_staleness_guard(self._harvest([0]), clock=9, window=3)
+        assert not any("Staleness guard" in r.message for r in caplog.records)
+
+    def test_all_stale_returns_none(self):
+        kept, dropped = apply_staleness_guard(
+            self._harvest([0, 0]), clock=10, window=1
+        )
+        assert kept is None and dropped == 2
+
+    def test_window_off_and_none_passthrough(self):
+        result = self._harvest([0])
+        assert apply_staleness_guard(result, 100, -1) == (result, 0)
+        assert apply_staleness_guard(None, 100, 4) == (None, 0)
+
+
+@pytest.mark.slow
+class TestSourceAgnosticIngest:
+    """Satellite: the replay ring ingests an externally-built (league)
+    harvest exactly like a self-play one — PER max-priority init,
+    validation, and checkpoint/spill interchange with self-play runs.
+    Service-driving (slow-marked); the pure-scatter case below stays
+    in tier-1."""
+
+    def _league_harvest(self, league_world):
+        env, fe, net, mcts = league_world
+        service = make_service(league_world)
+        emitter = TrajectoryEmitter(env, fe)
+        service.emitter = emitter
+        from alphatriangle_tpu.arena import play_service
+
+        play_service(service, games=3, max_moves=5, seed=21)
+        result = emitter.drain()
+        assert result is not None and result.num_experiences >= 3
+        return result
+
+    def test_device_ring_ingest_with_per_max_priority(
+        self, league_world, tiny_train_config
+    ):
+        from alphatriangle_tpu.rl.device_buffer import DeviceReplayBuffer
+
+        result = self._league_harvest(league_world)
+        cfg = tiny_train_config.model_copy(
+            update={
+                "BUFFER_CAPACITY": 64,
+                "USE_PER": True,
+                "PER_BETA_ANNEAL_STEPS": 10,
+            }
+        )
+        buf = DeviceReplayBuffer(
+            cfg,
+            grid_shape=result.grid.shape[1:],
+            other_dim=result.other_features.shape[1],
+            action_dim=result.policy_target.shape[1],
+        )
+        # Pre-load self-play-like rows and depress their priorities so
+        # max-priority init on the league rows is observable.
+        rng = np.random.default_rng(3)
+        pol = rng.random((8, result.policy_target.shape[1])).astype(np.float32)
+        pol /= pol.sum(axis=1, keepdims=True)
+        first = buf.add_dense(
+            rng.integers(-1, 2, (8, *result.grid.shape[1:])).astype(np.float32),
+            rng.random((8, result.other_features.shape[1]), dtype=np.float32),
+            pol,
+            rng.normal(size=8).astype(np.float32),
+        )
+        buf.update_priorities(np.asarray(first), np.full(8, 1e-3, np.float32))
+        max_p = buf.tree.max_priority
+        slots = buf.add_dense(
+            result.grid,
+            result.other_features,
+            result.policy_target,
+            result.value_target,
+            policy_weight=result.policy_weight,
+        )
+        assert len(slots) == result.num_experiences
+        prios = np.asarray(buf.get_state()["priorities"])
+        for s in np.asarray(slots):
+            assert prios[int(s)] == pytest.approx(max_p)
+
+    def test_spill_interchange_with_self_play_host_buffer(
+        self, league_world, tiny_train_config
+    ):
+        """A ring fed by league rows spills/restores interchangeably
+        with the host buffer a pure self-play run would write."""
+        from alphatriangle_tpu.rl.buffer import ExperienceBuffer
+        from alphatriangle_tpu.rl.device_buffer import DeviceReplayBuffer
+
+        result = self._league_harvest(league_world)
+        cfg = tiny_train_config.model_copy(
+            update={"BUFFER_CAPACITY": 32, "USE_PER": True,
+                    "PER_BETA_ANNEAL_STEPS": 10}
+        )
+        kw = dict(
+            grid_shape=result.grid.shape[1:],
+            other_dim=result.other_features.shape[1],
+            action_dim=result.policy_target.shape[1],
+        )
+        dev = DeviceReplayBuffer(cfg, **kw)
+        dev.add_dense(
+            result.grid,
+            result.other_features,
+            result.policy_target,
+            result.value_target,
+        )
+        state = dev.get_state()
+        host = ExperienceBuffer(cfg, action_dim=kw["action_dim"])
+        host.set_state(state)
+        assert len(host) == len(dev)
+        rt = DeviceReplayBuffer(cfg, **kw)
+        rt.set_state(host.get_state())
+        for k, v in dev.get_state()["storage"].items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(rt.get_state()["storage"][k]), k
+            )
+
+class TestRingScatterExternalBlock:
+    def test_ring_scatter_with_positions_on_external_block(self):
+        """The pure scatter itself is source-agnostic: an
+        externally-built block (league layout, one invalid row) lands
+        with per-row positions + keep mask for PER max-priority init.
+        Pure jitted numpy — cheap, so it stays in tier-1."""
+        import jax.numpy as jnp
+
+        from alphatriangle_tpu.rl.device_buffer import ring_scatter
+
+        cap, n, a = 8, 5, 12
+        storage = {
+            "grid": jnp.zeros((cap + 1, 1, 3, 4), jnp.int8),
+            "other_features": jnp.zeros((cap + 1, 5)),
+            "policy_target": jnp.zeros((cap + 1, a)),
+            "value_target": jnp.zeros(cap + 1),
+            "policy_weight": jnp.zeros(cap + 1),
+        }
+        policy = jnp.full((n, a), 1.0 / a)
+        policy = policy.at[2].set(0.0)  # not a distribution -> trash slot
+        block = {
+            "grid": jnp.ones((n, 1, 3, 4)),
+            "other": jnp.ones((n, 5)),
+            "policy": policy,
+            "ret": jnp.arange(n, dtype=jnp.float32),
+            "pw": jnp.ones(n),
+            "mask": jnp.ones(n, dtype=bool),
+        }
+        new_storage, cursor, written, positions, keep = ring_scatter(
+            storage, jnp.int32(0), (block,), cap, with_positions=True
+        )
+        assert int(written) == 4 and int(cursor) == 4
+        keep = np.asarray(keep)
+        assert keep.tolist() == [True, True, False, True, True]
+        pos = np.asarray(positions)
+        # Valid rows land in ring slots 0..3; the invalid row's
+        # position points at the trash slot (index cap).
+        assert pos[keep].tolist() == [0, 1, 2, 3]
+        assert pos[2] == cap
+        np.testing.assert_array_equal(
+            np.asarray(new_storage["value_target"])[:4], [0.0, 1.0, 3.0, 4.0]
+        )
