@@ -21,6 +21,8 @@ lint:
 		echo "== ruff unavailable; syntax gate via compileall =="; \
 		$(PY) -m compileall -q alphatriangle_tpu tests bench.py __graft_entry__.py; \
 	fi
+	@echo "== graftlint (docs/ANALYSIS.md) =="
+	@$(PY) -m alphatriangle_tpu.cli lint
 
 type:
 	@if $(PY) -c "import mypy" 2>/dev/null; then \

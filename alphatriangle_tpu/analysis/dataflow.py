@@ -1,0 +1,179 @@
+"""Lightweight intraprocedural dataflow for graftlint rules.
+
+Deliberately lexical: statements are ordered by source position, not by
+control-flow path. That over-approximates "read after donation" across
+branches the same way a human skimming the function does — good enough
+to catch the PR 3 bug class without a CFG, and every rule's verdict is
+fixture-pinned so the approximation can't drift silently.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def attr_path(node: ast.AST) -> str | None:
+    """Dotted path of a Name/Attribute chain ("self.states.score"),
+    or None for anything that isn't a pure chain."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Dotted path of a call's callee, or None."""
+    return attr_path(call.func)
+
+
+def strip_subscript(node: ast.AST) -> ast.AST:
+    """x[i][j] -> x (subscripting doesn't change which object syncs)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def literal_positions(node: ast.AST) -> tuple[int, ...]:
+    """donate_argnums literal -> positions. Non-literal -> empty."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def string_prefix(node: ast.AST) -> str | None:
+    """Best-effort constant prefix of a program-name expression:
+    "learner_step" -> itself, f"self_play_chunk/t{n}" -> the leading
+    constant, serve_program_name(...) -> "serve/" (the one non-literal
+    naming helper the serving stack uses)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    if isinstance(node, ast.Call):
+        name = call_name(node) or ""
+        if "serve" in name.split(".")[-1]:
+            return "serve/"
+    return None
+
+
+def assignment_targets(stmt: ast.stmt) -> list[str]:
+    """Dotted paths bound by an assignment statement (tuple targets
+    flattened); empty for non-assignments."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: list[str] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(p for e in t.elts if (p := attr_path(e)) is not None)
+        else:
+            p = attr_path(t)
+            if p is not None:
+                out.append(p)
+    return out
+
+
+def find_call(node: ast.AST, pred, skip_lambda: bool = True) -> ast.Call | None:
+    """First Call under `node` satisfying `pred`, skipping Lambda
+    bodies (a lambda factory's inner jit is NOT the assigned value)."""
+    for child in _walk(node, skip_lambda):
+        if isinstance(child, ast.Call) and pred(child):
+            return child
+    return None
+
+
+def _walk(node: ast.AST, skip_lambda: bool):
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if skip_lambda and isinstance(child, ast.Lambda):
+                continue
+            stack.append(child)
+
+
+def occurrences_after(
+    func: ast.AST, path: str, end_line: int, end_col: int
+) -> list[tuple[int, int, bool]]:
+    """(line, col, is_store) events for `path` inside `func` strictly
+    after (end_line, end_col), in source order. An Attribute chain
+    event takes its ctx from the outermost link."""
+    events: list[tuple[int, int, bool]] = []
+    for node in ast.walk(func):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if attr_path(node) != path:
+                continue
+            ctx = getattr(node, "ctx", None)
+            pos = (node.lineno, node.col_offset)
+            if pos <= (end_line, end_col):
+                continue
+            events.append(
+                (node.lineno, node.col_offset, isinstance(ctx, ast.Store))
+            )
+    events.sort()
+    return events
+
+
+class FunctionFacts:
+    """Per-function name classification for placement/host checks."""
+
+    def __init__(self, func: ast.AST):
+        self.committed: set[str] = set()  # assigned from jax.device_put
+        self.host_known: set[str] = set()  # numpy/device_get/literal-born
+        for stmt in ast.walk(func):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = assignment_targets(stmt)
+            if not names:
+                continue
+            kind = self._classify(stmt.value)
+            if kind == "committed":
+                self.committed.update(names)
+            elif kind == "host":
+                self.host_known.update(names)
+
+    @staticmethod
+    def _classify(value: ast.AST) -> str | None:
+        if isinstance(value, ast.Call):
+            name = call_name(value) or ""
+            if name.endswith("device_put"):
+                return "committed"
+            if name.endswith("device_get"):
+                return "host"  # the fetch result lives on host
+            root = name.split(".", 1)[0]
+            if root in ("np", "numpy"):
+                return "host"
+        if isinstance(value, (ast.List, ast.Dict, ast.Constant)):
+            return "host"
+        return None
+
+    def classify_arg(self, arg: ast.AST) -> str | None:
+        """committed / host / None(unknown) for one call argument."""
+        if isinstance(arg, ast.Call):
+            return self._classify(arg)
+        if isinstance(arg, (ast.List, ast.Dict, ast.Constant)):
+            return "host"
+        path = attr_path(strip_subscript(arg))
+        if path is None:
+            return None
+        if path in self.committed:
+            return "committed"
+        if path in self.host_known:
+            return "host"
+        return None

@@ -1,0 +1,468 @@
+"""graftlint rule catalog: the six JAX-hazard classes this repo has
+actually been bitten by (docs/ANALYSIS.md has the war stories).
+
+Every rule yields `Finding`s from a parsed `Module`; each has a
+fixture-pinned true positive AND a near-miss true negative in
+tests/test_analysis.py, so precision is a test contract, not a hope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..telemetry.flight import program_family
+from .dataflow import (
+    FunctionFacts,
+    assignment_targets,
+    attr_path,
+    call_name,
+    find_call,
+    literal_positions,
+    occurrences_after,
+    string_prefix,
+    strip_subscript,
+)
+from .model import Finding, Module
+
+# The four dispatch families PR 10 instrumented: every dispatch of one
+# of these MUST sit inside a FlightRecorder intent/seal bracket, or a
+# wedge inside it is invisible to `cli doctor`.
+FLIGHT_FAMILIES = ("rollout", "learner", "megastep", "serve")
+
+_NP_FETCH = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_JIT_TAILS = (".jit", ".pjit")
+# np.random constructors that ARE tracked (explicit seeded generators).
+_TRACKED_NP_RANDOM = {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+
+
+class Rule:
+    name: str = ""
+    description: str = ""
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _finding(rule: Rule, mod: Module, node: ast.AST, message: str) -> Finding:
+    f = Finding(
+        rule=rule.name,
+        path=mod.relpath,
+        line=node.lineno,
+        col=node.col_offset,
+        message=message,
+        context=mod.enclosing_context(node),
+    )
+    return f.with_text(mod.line_text(node.lineno))
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call) or ""
+    return name == "jit" or name == "pjit" or name.endswith(_JIT_TAILS)
+
+
+def _donating_jit(node: ast.AST) -> tuple[ast.Call, tuple[int, ...]] | None:
+    """The jit/pjit call (with literal donate_argnums) under `node`,
+    lambda bodies excluded — a factory's inner jit is not this value."""
+    call = find_call(
+        node,
+        lambda c: _is_jit_call(c)
+        and any(k.arg == "donate_argnums" for k in c.keywords),
+    )
+    if call is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            positions = literal_positions(kw.value)
+            if positions:
+                return call, positions
+    return None
+
+
+class ProgramIndex:
+    """Module-wide map of names bound to device programs.
+
+    donating: dotted target path -> donated arg positions (from
+    `jax.jit(..., donate_argnums=...)`, directly or nested inside a
+    `cache.wrap(...)` RHS, or aliased through a local name).
+    wrapped: dotted target path -> program-name prefix for every
+    `<cache>.wrap("name", ...)` binding (donating or not).
+    """
+
+    def __init__(self, mod: Module):
+        self.donating: dict[str, tuple[int, ...]] = {}
+        self.wrapped: dict[str, str] = {}
+        for stmt in ast.walk(mod.tree):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = assignment_targets(stmt)
+            if not names:
+                continue
+            rhs = stmt.value
+            donated = _donating_jit(rhs)
+            # Alias: `self._p = cache.wrap("x", g)` where g donates.
+            if donated is None and isinstance(rhs, ast.Call):
+                for arg in rhs.args:
+                    p = attr_path(arg)
+                    if p in self.donating:
+                        donated = (None, self.donating[p])  # type: ignore[assignment]
+                        break
+            if donated is not None:
+                for n in names:
+                    self.donating[n] = donated[1]
+            wrap = find_call(
+                rhs,
+                lambda c: isinstance(c.func, ast.Attribute)
+                and c.func.attr == "wrap"
+                and c.args,
+            )
+            if wrap is not None:
+                prefix = string_prefix(wrap.args[0])
+                if prefix:
+                    for n in names:
+                        self.wrapped[n] = prefix
+
+
+class UseAfterDonation(Rule):
+    name = "use-after-donation"
+    description = (
+        "A buffer passed at a donated position of a donating program is "
+        "read again afterwards — donation invalidated it (the PR 3 "
+        "silent-stale-params class)."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        index = ProgramIndex(mod)
+        if not index.donating:
+            return
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = attr_path(call.func)
+            positions = index.donating.get(callee or "")
+            if not positions:
+                continue
+            func = mod.enclosing_function(call)
+            if func is None:
+                continue
+            stmt = mod.enclosing_statement(call)
+            rebound = set(assignment_targets(stmt))
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = attr_path(call.args[pos])
+                if arg is None or arg in rebound:
+                    continue  # expression arg, or rebound in-place
+                end = (
+                    getattr(stmt, "end_lineno", stmt.lineno),
+                    getattr(stmt, "end_col_offset", 0),
+                )
+                events = occurrences_after(func, arg, end[0], end[1])
+                if events and not events[0][2]:  # first event is a Load
+                    line, col, _ = events[0]
+                    f = Finding(
+                        rule=self.name,
+                        path=mod.relpath,
+                        line=line,
+                        col=col,
+                        message=(
+                            f"`{arg}` was donated to `{callee}` (arg "
+                            f"{pos}, line {call.lineno}) and is read here "
+                            "afterwards; donation invalidated the buffer "
+                            "— rebind the result over it or stop donating"
+                        ),
+                        context=mod.enclosing_context(call),
+                    )
+                    yield f.with_text(mod.line_text(line))
+
+
+class HostSyncInHotPath(Rule):
+    name = "host-sync-in-hot-path"
+    description = (
+        "Blocking device->host sync inside a dispatch-latency-critical "
+        "module (.item(), block_until_ready, jax.device_get, shape-only "
+        "np.asarray, fragmented np.asarray fetches of device state)."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not mod.is_hot_path:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth == "item" and not node.args:
+                    yield _finding(
+                        self,
+                        mod,
+                        node,
+                        ".item() forces a blocking device sync per scalar "
+                        "— batch the fetch (one jax.device_get) outside "
+                        "the hot loop",
+                    )
+                    continue
+                if meth == "block_until_ready":
+                    yield _finding(
+                        self,
+                        mod,
+                        node,
+                        ".block_until_ready() stalls the dispatch "
+                        "pipeline — only benchmarks should fence",
+                    )
+                    continue
+            if name == "jax.device_get" or name == "jax.block_until_ready":
+                yield _finding(
+                    self,
+                    mod,
+                    node,
+                    f"{name} in a hot module — if this IS the one "
+                    "deliberate fetch of the iteration, mark it "
+                    "`# graftlint: allow(host-sync-in-hot-path)` with the "
+                    "reason; otherwise batch it",
+                )
+                continue
+            if name in _NP_FETCH:
+                parent = mod.parents.get(node)
+                if (
+                    isinstance(parent, ast.Attribute)
+                    and parent.attr == "shape"
+                ):
+                    yield _finding(
+                        self,
+                        mod,
+                        node,
+                        "np.asarray(x).shape transfers the whole array to "
+                        "read static metadata — use x.shape directly (no "
+                        "sync, works for host and device arrays)",
+                    )
+                    continue
+                if node.args:
+                    target = strip_subscript(node.args[0])
+                    path = attr_path(target) or ""
+                    parts = path.split(".")
+                    if parts[0] == "self" and len(parts) >= 3:
+                        yield _finding(
+                            self,
+                            mod,
+                            node,
+                            f"np.asarray({path}…) fetches device state "
+                            "attribute-by-attribute — batch the reads "
+                            "into ONE jax.device_get of a tuple",
+                        )
+
+
+class MixedPlacementDispatch(Rule):
+    name = "mixed-placement-dispatch"
+    description = (
+        "A cached-program call site mixing jax.device_put-committed "
+        "args with host-fresh args — the uncommitted ones re-place per "
+        "call and can silently recompile the program (the PR 5 48s "
+        "megastep recompile class)."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        index = ProgramIndex(mod)
+        programs = set(index.donating) | set(index.wrapped)
+        if not programs:
+            return
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            facts: FunctionFacts | None = None
+            for call in ast.walk(func):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = attr_path(call.func)
+                if callee not in programs or len(call.args) < 2:
+                    continue
+                if facts is None:
+                    facts = FunctionFacts(func)
+                kinds = [facts.classify_arg(a) for a in call.args]
+                if "committed" in kinds and "host" in kinds:
+                    committed = [
+                        i for i, k in enumerate(kinds) if k == "committed"
+                    ]
+                    host = [i for i, k in enumerate(kinds) if k == "host"]
+                    yield _finding(
+                        self,
+                        mod,
+                        call,
+                        f"call to `{callee}` mixes device_put-committed "
+                        f"args (positions {committed}) with host args "
+                        f"(positions {host}) — commit ALL hot-dispatch "
+                        "args up front or the placement mapping changes "
+                        "per call and recompiles",
+                    )
+
+
+class UnbracketedHotDispatch(Rule):
+    name = "unbracketed-hot-dispatch"
+    description = (
+        "A hot-family cached program (rollout/learner/megastep/serve) "
+        "dispatched outside a FlightRecorder intent/seal bracket — a "
+        "wedge inside it would be invisible to `cli doctor`."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        index = ProgramIndex(mod)
+        hot = {
+            target: prefix
+            for target, prefix in index.wrapped.items()
+            if program_family(prefix) in FLIGHT_FAMILIES
+        }
+        if not hot:
+            return
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = attr_path(call.func)
+            if callee not in hot:
+                continue
+            if self._bracketed(mod, call):
+                continue
+            yield _finding(
+                self,
+                mod,
+                call,
+                f"`{callee}` dispatches flight family "
+                f"'{program_family(hot[callee])}' outside a "
+                "flight_span()/flight.begin() bracket — a wedge here "
+                "leaves no intent record for `cli doctor` to classify",
+            )
+
+    @staticmethod
+    def _bracketed(mod: Module, call: ast.Call) -> bool:
+        # (a) lexically inside `with flight_span(...)`
+        cur = mod.parents.get(call)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        n = call_name(expr) or ""
+                        if n.split(".")[-1] == "flight_span":
+                            return True
+            cur = mod.parents.get(cur)
+        # (b) a `<...>flight.begin(...)` earlier in the same function
+        # (the async begin/finish seal pattern in rl/trainer.py)
+        func = mod.enclosing_function(call)
+        if func is None:
+            return False
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Call)
+                and node.lineno <= call.lineno
+                and (call_name(node) or "").endswith("flight.begin")
+            ):
+                return True
+        return False
+
+
+class DebugArtifact(Rule):
+    name = "debug-artifact"
+    description = (
+        "Debug scaffolding reachable from jitted code: jax.debug.print/"
+        "breakpoint recompiles and serializes dispatches; breakpoint()/"
+        "pdb wedges an unattended chip window forever."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                modname = (
+                    node.module
+                    if isinstance(node, ast.ImportFrom)
+                    else ",".join(a.name for a in node.names)
+                )
+                if modname and "pdb" in modname.split(","):
+                    yield _finding(
+                        self, mod, node, "pdb import — an unattended run "
+                        "hitting this wedges the window until the watchdog "
+                        "kills it"
+                    )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if name in ("jax.debug.print", "jax.debug.breakpoint"):
+                yield _finding(
+                    self,
+                    mod,
+                    node,
+                    f"{name} left in device code — it forces host "
+                    "callbacks per dispatch and changes the compiled "
+                    "program",
+                )
+            elif name == "breakpoint":
+                yield _finding(
+                    self, mod, node, "breakpoint() call — hangs any "
+                    "non-interactive run"
+                )
+            elif name.startswith("pdb."):
+                yield _finding(
+                    self, mod, node, f"{name} call — hangs any "
+                    "non-interactive run"
+                )
+
+
+class UntrackedRng(Rule):
+    name = "untracked-rng"
+    description = (
+        "Global-state RNG (np.random.*, stdlib random) in device-code "
+        "modules: invisible to compile-cache keys, unreproducible under "
+        "dispatch reordering — use jax PRNG keys or a seeded "
+        "np.random.default_rng Generator."
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not mod.is_device_code:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = (
+                    [node.module]
+                    if isinstance(node, ast.ImportFrom)
+                    else [a.name for a in node.names]
+                )
+                if "random" in names:
+                    yield _finding(
+                        self,
+                        mod,
+                        node,
+                        "stdlib `random` imported in a device-code module "
+                        "— its global state never enters a program key; "
+                        "thread a jax PRNG key instead",
+                    )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            parts = name.split(".")
+            if (
+                len(parts) >= 3
+                and parts[0] in ("np", "numpy")
+                and parts[1] == "random"
+                and parts[2] not in _TRACKED_NP_RANDOM
+            ):
+                yield _finding(
+                    self,
+                    mod,
+                    node,
+                    f"{name} uses numpy's GLOBAL rng — seedable but "
+                    "shared across threads and invisible to cache keys; "
+                    "use np.random.default_rng(seed) or a jax key",
+                )
+
+
+RULES: tuple[Rule, ...] = (
+    UseAfterDonation(),
+    HostSyncInHotPath(),
+    MixedPlacementDispatch(),
+    UnbracketedHotDispatch(),
+    DebugArtifact(),
+    UntrackedRng(),
+)
+
+RULE_NAMES = tuple(r.name for r in RULES)

@@ -1860,6 +1860,61 @@ def cmd_mem(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """graftlint: the JAX-hazard static analyzer (docs/ANALYSIS.md).
+
+    Walks the package AST for the six hazard classes this repo has
+    actually hit (use-after-donation, host-sync-in-hot-path,
+    mixed-placement-dispatch, unbracketed-hot-dispatch, debug-artifact,
+    untracked-rng). Never imports JAX — runs in CI images, in the
+    tpu_watch.sh preflight, and beside a wedged chip, like `cli mem`
+    and `cli doctor` (pinned by an import-guard test).
+
+    Exit 0 clean / 1 findings or stale baseline entries / 2 parse
+    error (or unknown --rule)."""
+    import json as _json
+
+    from .analysis import run_lint, write_baseline
+
+    root = Path(args.path) if args.path else Path(__file__).resolve().parent
+    if not root.exists():
+        print(f"lint root {root} does not exist", file=sys.stderr)
+        return 2
+    if args.baseline is not None:
+        baseline = Path(args.baseline)
+    else:
+        # Checked-in default: lint_baseline.json beside the scanned
+        # tree (repo root for the package default), else inside it.
+        candidates = [
+            root.parent / "lint_baseline.json",
+            root / "lint_baseline.json",
+        ]
+        baseline = next((c for c in candidates if c.exists()), None)
+    try:
+        report = run_lint(
+            root, rule_names=args.rule or None, baseline_path=baseline
+        )
+    except ValueError as e:  # unknown rule / corrupt baseline
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        target = baseline or root.parent / "lint_baseline.json"
+        write_baseline(target, report.findings)
+        print(
+            f"baseline written: {target} "
+            f"({len(report.findings)} entr"
+            f"{'y' if len(report.findings) == 1 else 'ies'})"
+        )
+        return 0
+    if args.json:
+        payload = report.as_dict()
+        payload["baseline_path"] = str(baseline) if baseline else None
+        print(_json.dumps(payload))
+    else:
+        print(report.render())
+    return report.exit_code
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Postmortem window forensics: classify how a run ended from its
     on-disk evidence alone (flight ring + health.json + wedge report +
@@ -2625,6 +2680,49 @@ def main(argv: list[str] | None = None) -> int:
         "--device", default=None, choices=["auto", "tpu", "cpu"]
     )
 
+    lint = sub.add_parser(
+        "lint",
+        help="graftlint: AST-based JAX-hazard analyzer (donation, host "
+        "syncs, placement, flight coverage, debug artifacts, RNG) — "
+        "no JAX import; exit 0 clean / 1 findings / 2 parse error "
+        "(docs/ANALYSIS.md).",
+    )
+    lint.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="Tree to lint (default: the installed alphatriangle_tpu "
+        "package).",
+    )
+    lint.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="Run only this rule (repeatable).",
+    )
+    lint.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help="Baseline file of grandfathered finding keys (default: "
+        "lint_baseline.json beside the linted tree). Stale entries "
+        "fail the lint.",
+    )
+    lint.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="Grandfather every current finding into the baseline file "
+        "and exit 0.",
+    )
+    lint.add_argument(
+        "--json",
+        action="store_true",
+        help='One-line JSON verdict (leads with "schema": '
+        f'"alphatriangle.lint.v1") — what tpu_watch.sh folds into '
+        "windows.jsonl.",
+    )
+
     mem = sub.add_parser(
         "mem",
         help="Memory-attribution table for a run (programs, train "
@@ -2790,6 +2888,7 @@ def main(argv: list[str] | None = None) -> int:
         "serve": cmd_serve,
         "league": cmd_league,
         "mem": cmd_mem,
+        "lint": cmd_lint,
     }
     return handlers[args.command](args)
 

@@ -605,13 +605,13 @@ class SelfPlayEngine:
             payload: dict | None = None
             t0 = time.perf_counter()
             if fetch_experiences:
-                host = jax.device_get(outputs)  # the one transfer per chunk
+                host = jax.device_get(outputs)  # graftlint: allow(host-sync-in-hot-path) the one transfer per chunk
             else:
                 payload = {
                     "mat": outputs.pop("mat"),
                     "flush": outputs.pop("flush"),
                 }
-                host = jax.device_get(outputs)  # stats + trace only (small)
+                host = jax.device_get(outputs)  # graftlint: allow(host-sync-in-hot-path) stats + trace only (small)
         dt = time.perf_counter() - t0
         with self._transfer_lock:
             self.transfer_d2h_seconds += dt

@@ -517,7 +517,9 @@ class Trainer:
     ) -> tuple[dict[str, float], np.ndarray] | None:
         """One SGD step. Returns (metrics, per-sample TD errors) or None
         on an empty batch (reference `trainer.py:204-310` contract)."""
-        n = int(np.asarray(batch["value_target"]).shape[0])
+        # Static shape read — np.asarray here would fetch the whole
+        # array from the device just to look at its metadata.
+        n = int(batch["value_target"].shape[0])
         if n == 0:
             return None
         self._check_local_batch(n)
@@ -533,7 +535,7 @@ class Trainer:
             # ONE blocking transfer for everything this step produced
             # (fetching each metric separately costs a round trip apiece).
             t0 = time.perf_counter()
-            host_metrics, td_host = jax.device_get(
+            host_metrics, td_host = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) the one blocking fetch per step
                 (metrics, td if jax.process_count() == 1 else None)
             )
             self.transfer_d2h_seconds += time.perf_counter() - t0
@@ -579,7 +581,7 @@ class Trainer:
         """
         if not batches:
             return None
-        n = int(np.asarray(batches[0]["value_target"]).shape[0])
+        n = int(batches[0]["value_target"].shape[0])
         if n == 0:  # same skip contract as train_step
             return None
         self._check_local_batch(n)
@@ -714,7 +716,7 @@ class Trainer:
         k = handle["k"]
         metrics_k, td_k = handle["metrics"], handle["td"]
         t0 = time.perf_counter()
-        host_metrics_k, td_host = jax.device_get(
+        host_metrics_k, td_host = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) the one blocking fetch per fused group
             (metrics_k, td_k if jax.process_count() == 1 else None)
         )
         self.transfer_d2h_seconds += time.perf_counter() - t0

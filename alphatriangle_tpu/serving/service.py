@@ -276,11 +276,11 @@ class PolicyService:
                 pre_states = self.sessions.states
                 rewards, dones = self.sessions.step(actions, mask)
                 # Response materialization: the host sync IS the
-                # product here (clients need their move), one fetch
-                # per dispatch.
-                rewards_np = np.asarray(rewards)
-                dones_np = np.asarray(dones)
-                scores_np = np.asarray(self.sessions.states.score)
+                # product here (clients need their move) — ONE fetch
+                # per dispatch for all three result arrays, not three.
+                rewards_np, dones_np, scores_np = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) the one deliberate response fetch per dispatch
+                    (rewards, dones, self.sessions.states.score)
+                )
             t1 = self._clock()
 
             if self.emitter is not None:

@@ -205,11 +205,20 @@ class SessionSlots:
         """Release a session's slot (re-frozen so the lane stays inert)
         and return its final summary. Reads the slot's score/step_count
         from the device — retirement is a host sync by definition."""
+        import jax
+
         s = self._sessions.pop(sid)
         self._by_slot.pop(s.slot, None)
-        s.score = float(np.asarray(self.states.score[s.slot]))
-        s.moves = int(np.asarray(self.states.step_count[s.slot]))
-        s.done = bool(np.asarray(self.states.done[s.slot]))
+        score, moves, done = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) retirement IS the fetch; one transfer for all three scalars
+            (
+                self.states.score[s.slot],
+                self.states.step_count[s.slot],
+                self.states.done[s.slot],
+            )
+        )
+        s.score = float(score)
+        s.moves = int(moves)
+        s.done = bool(done)
         self.states = self._freeze_slot(self.states, s.slot)
         self._free.append(s.slot)
         self.retired_total += 1
@@ -252,8 +261,9 @@ class SessionSlots:
         """(scores, step_counts, done) for the whole slot array as
         NumPy — ONE host sync; the arena client calls this once at the
         end of a run instead of per move."""
-        return (
-            np.asarray(self.states.score),
-            np.asarray(self.states.step_count),
-            np.asarray(self.states.done),
+        import jax
+
+        scores, steps, done = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) the one end-of-run fetch the docstring promises
+            (self.states.score, self.states.step_count, self.states.done)
         )
+        return np.asarray(scores), np.asarray(steps), np.asarray(done)

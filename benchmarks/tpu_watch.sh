@@ -35,6 +35,23 @@ warm_s=${WATCH_WARM_S:-900}
 tune_s=${WATCH_TUNE_S:-600}
 runs_root=.alphatriangle_data/AlphaTriangleTPU/runs
 
+# Lint preflight (docs/ANALYSIS.md): a chip window is too expensive to
+# spend discovering a host-sync regression or a torn donation at
+# runtime, so a window NEVER launches with dirty lint. graftlint is
+# JAX-free (same contract as `cli doctor` below) — safe to run even
+# while the chip is wedged. The JSON verdict is kept and folded into
+# every windows.jsonl line so postmortems record what static state the
+# window launched from.
+lint_row=$(timeout 60 python -m alphatriangle_tpu.cli lint --json 2>/dev/null)
+lint_rc=$?
+[ -n "$lint_row" ] || lint_row='{"schema": "alphatriangle.lint.v1", "verdict": "unavailable", "exit_code": null}'
+if [ "$lint_rc" -ne 0 ]; then
+  echo "graftlint preflight FAILED (rc=$lint_rc); refusing to launch a chip window:" >&2
+  timeout 60 python -m alphatriangle_tpu.cli lint >&2
+  exit 1
+fi
+echo "$(date +%T) graftlint preflight clean" >&2
+
 # Archive the newest run's postmortem artifacts and record a doctor
 # verdict for this window. $1 labels why the window ended (probe-failed
 # / cmd-aborted / cmd-wedged). Best-effort throughout: forensics must
@@ -54,8 +71,8 @@ archive_window() {
   verdict=$(timeout 60 python -m alphatriangle_tpu.cli doctor "$run_dir" --json 2>/dev/null)
   rc=$?
   [ -n "$verdict" ] || verdict='{"verdict": "unreadable", "exit_code": null}'
-  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "doctor": %s}\n' \
-    "$ts" "$why" "$run_dir" "$verdict" >> "$runs_root/_windows/windows.jsonl"
+  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "doctor": %s, "lint": %s}\n' \
+    "$ts" "$why" "$run_dir" "$verdict" "$lint_row" >> "$runs_root/_windows/windows.jsonl"
   echo "$verdict" > "$dest/doctor.json"
   echo "$(date +%T) window archived: $dest ($why, doctor rc=$rc)" >&2
 }
