@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke
 
 check: lint type test
 
@@ -89,6 +89,18 @@ league-smoke:
 # that path.
 doctor-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/doctor_smoke.py
+
+# Self-healing gate (docs/ROBUSTNESS.md): injected faults against real
+# training children — a mid-run dispatch hang must die by the watchdog's
+# exit 113 and be restarted by the supervisor from the latest committed
+# checkpoint (completing with step loss <= one checkpoint cadence, the
+# death->verdict->restart chain in supervisor.jsonl); SIGTERM must be
+# absorbed as an emergency checkpoint + exit 114 that doctor reads as
+# `preempted` and a rerun resumes; SIGKILL mid-checkpoint-save must
+# leave a torn step dir that restore skips for the prior committed one.
+# The supervisor parent runs with jax imports hard-blocked.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos_smoke.py
 
 # Kernel-library gate (docs/KERNELS.md): every interchangeable lowering
 # in alphatriangle_tpu/ops/ (gather_rows, backup_update, per_sample)

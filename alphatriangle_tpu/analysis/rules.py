@@ -456,6 +456,47 @@ class UntrackedRng(Rule):
                 )
 
 
+class UntrappedExit(Rule):
+    name = "untrapped-exit"
+    description = (
+        "Bare sys.exit/os._exit in a hot-path or training module — it "
+        "bypasses the emergency-checkpoint/preemption path (loop.run's "
+        "finally) and the run dies without spilling state. Exiting is "
+        "the watchdog's and the supervisor's job (telemetry/flight.py, "
+        "supervise/)."
+    )
+
+    # The sanctioned exiters: the dispatch watchdog (os._exit is the
+    # POINT — the thread that would run shutdown is the wedged one) and
+    # the supervisor parent, which owns process lifecycle.
+    _WHITELIST_DIRS = ("supervise",)
+    _WHITELIST_FILES = ("telemetry/flight.py",)
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        in_scope = mod.is_hot_path or mod.top_dir == "training"
+        if not in_scope:
+            return
+        if (
+            mod.top_dir in self._WHITELIST_DIRS
+            or mod.relpath in self._WHITELIST_FILES
+        ):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if name in ("sys.exit", "os._exit"):
+                yield _finding(
+                    self,
+                    mod,
+                    node,
+                    f"{name} in a hot-path/training module skips the "
+                    "emergency checkpoint + buffer spill + flight flush "
+                    "(loop.run's finally) — return a LoopStatus / raise "
+                    "instead and let runner.EXIT_CODES map it",
+                )
+
+
 RULES: tuple[Rule, ...] = (
     UseAfterDonation(),
     HostSyncInHotPath(),
@@ -463,6 +504,7 @@ RULES: tuple[Rule, ...] = (
     UnbracketedHotDispatch(),
     DebugArtifact(),
     UntrackedRng(),
+    UntrappedExit(),
 )
 
 RULE_NAMES = tuple(r.name for r in RULES)

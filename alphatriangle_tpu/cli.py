@@ -1924,15 +1924,20 @@ def cmd_doctor(args: argparse.Namespace) -> int:
 
     Exit code IS the verdict (telemetry/flight.py DOCTOR_EXIT_CODES):
     0 clean, 2 never-started, 3 compile-hung, 4 dispatch-hung,
-    5 host-stall, 6 oom. `benchmarks/tpu_watch.sh` appends the verdict
-    to its cumulative windows.jsonl per reclaimed window."""
+    5 host-stall, 6 oom, 7 preempted. `benchmarks/tpu_watch.sh` appends
+    the verdict to its cumulative windows.jsonl per reclaimed window.
+    (Related process exit codes, docs/OBSERVABILITY.md: 113 = dispatch
+    watchdog wedge, 114 = preemption absorbed, 115 = `cli supervise`
+    gave up.)"""
     import json as _json
 
     from .telemetry.flight import (
         FLIGHT_FILENAME,
+        PREEMPT_REPORT_FILENAME,
         WEDGE_REPORT_FILENAME,
         classify_run,
         read_flight,
+        read_preempt_report,
         read_wedge_report,
     )
     from .telemetry.health import read_health
@@ -1948,9 +1953,12 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     flight = read_flight(run_dir / FLIGHT_FILENAME)
     health = read_health(run_dir / "health.json")
     wedge = read_wedge_report(run_dir / WEDGE_REPORT_FILENAME)
+    preempt = read_preempt_report(run_dir / PREEMPT_REPORT_FILENAME)
     ledger = resolve_ledger_path(run_dir)
     utils = read_ledger(ledger, kinds={"util"}) if ledger else []
-    verdict = classify_run(flight, health=health, utils=utils, wedge=wedge)
+    verdict = classify_run(
+        flight, health=health, utils=utils, wedge=wedge, preempt=preempt
+    )
     if args.json:
         verdict["run_dir"] = str(run_dir)
         print(_json.dumps(verdict))
@@ -1971,6 +1979,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         f"  evidence  {ev['intents']} intents, {ev['seals']} seals, "
         f"{ev['unsealed']} unsealed"
         + (", wedge report" if ev["wedge_report"] else "")
+        + (", preempt report" if ev.get("preempt_report") else "")
         + (", stalled heartbeat" if ev["stalled"] else "")
         + (
             f", mem {ev['mem_utilization']:.0%}"
@@ -1979,6 +1988,51 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         )
     )
     return int(verdict["exit_code"])
+
+
+def cmd_supervise(args: argparse.Namespace) -> int:
+    """Self-healing parent for `cli train` / `cli league`: spawn the
+    child, classify every death with the doctor's evidence, and apply
+    the verdict->action matrix (restart from the latest committed
+    checkpoint with backoff, degrade on OOM, quarantine a repeatedly
+    wedging program family, give up past the restart budget). JAX-free
+    like `cli doctor` — the parent outlives a wedged chip.
+
+    Exits 0 when the child completes, 115 when the policy gives up,
+    or the child's own code after a forwarded SIGTERM/SIGINT (114 for
+    an absorbed preemption). Events land in runs/<run>/supervisor.jsonl
+    (docs/ROBUSTNESS.md)."""
+    from .supervise import RecoveryPolicy, Supervisor
+
+    child = list(args.child or [])
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        child = ["train"]
+    if child[0] in ("train", "league"):
+        # Pin the child to the supervised run dir: the restarted child
+        # must resume ITS run, not auto-resume-redirect to whichever
+        # run dir is newest, and train/league both restore from their
+        # named run's latest valid checkpoint unconditionally.
+        if "--run-name" not in child:
+            child += ["--run-name", args.run_name]
+        if args.root_dir and "--root-dir" not in child:
+            child += ["--root-dir", args.root_dir]
+        if child[0] == "train" and "--no-auto-resume" not in child:
+            child.append("--no-auto-resume")
+    run_dir = _resolve_run_dir(args.run_name, args.root_dir)
+    if run_dir is None:
+        return 2
+    policy = RecoveryPolicy(
+        max_restarts=args.max_restarts,
+        circuit_breaker_deaths=args.circuit_breaker,
+        backoff_base_s=args.backoff_base,
+        backoff_max_s=args.backoff_max,
+        quarantine_after=args.quarantine_after,
+    )
+    argv = [sys.executable, "-m", "alphatriangle_tpu.cli", *child]
+    print(f"supervise: {run_dir}\n  child: {' '.join(child)}")
+    return Supervisor(argv, run_dir, policy=policy).run()
 
 
 def _tune_axes(
@@ -2304,6 +2358,57 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="Emit the verdict as one JSON line (tpu_watch.sh appends "
         "it to windows.jsonl).",
+    )
+
+    supervise = sub.add_parser(
+        "supervise",
+        help="Self-healing parent for train/league: restart a dead "
+        "child from its latest committed checkpoint per the doctor "
+        "verdict (backoff, OOM degrade, family quarantine, circuit "
+        "breaker). JAX-free; events -> runs/<run>/supervisor.jsonl.",
+    )
+    supervise.add_argument(
+        "--run-name",
+        required=True,
+        help="Run directory to supervise (injected into the child's "
+        "argv when absent there).",
+    )
+    supervise.add_argument("--root-dir", default=None)
+    supervise.add_argument(
+        "--max-restarts",
+        type=int,
+        default=8,
+        metavar="N",
+        help="Total restart budget before giving up (exit 115).",
+    )
+    supervise.add_argument(
+        "--circuit-breaker",
+        type=int,
+        default=3,
+        metavar="N",
+        help="Consecutive deaths without a new committed checkpoint "
+        "that trip the breaker (exit 115).",
+    )
+    supervise.add_argument(
+        "--backoff-base", type=float, default=5.0, metavar="SECONDS"
+    )
+    supervise.add_argument(
+        "--backoff-max", type=float, default=300.0, metavar="SECONDS"
+    )
+    supervise.add_argument(
+        "--quarantine-after",
+        type=int,
+        default=2,
+        metavar="N",
+        help="Wedges on one program family before its riskiest knob is "
+        "quarantined (megastep -> sync, learner -> K=1, rollout -> "
+        "sync rollouts).",
+    )
+    supervise.add_argument(
+        "child",
+        nargs=argparse.REMAINDER,
+        help="Child subcommand + flags after '--' "
+        "(default: train --run-name <run>).",
     )
 
     health = sub.add_parser(
@@ -2876,6 +2981,7 @@ def main(argv: list[str] | None = None) -> int:
         "watch": cmd_watch,
         "health": cmd_health,
         "doctor": cmd_doctor,
+        "supervise": cmd_supervise,
         "perf": cmd_perf,
         "compare": cmd_compare,
         "trace": cmd_trace,

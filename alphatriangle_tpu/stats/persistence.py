@@ -12,11 +12,22 @@ replay buffer spills to a compressed `.npz` (fixed-shape arrays, no
 pickle). Improvement over the reference: PER priorities are persisted
 and restored (the reference resets them to max on resume,
 `runner.py:87-91`).
+
+Crash-integrity contract (docs/ROBUSTNESS.md): every sidecar file
+(meta.json, configs.json, buffer spills, commit markers) is written via
+tmp + `os.replace`, so a SIGKILL mid-write can never leave a torn file
+that auto-resume trusts. The Orbax tree itself is async-written and CAN
+be torn by a kill — so a `step_XXXXXXXX.commit` marker is written only
+after `wait_until_finished()` proves the tree landed, and restore skips
+any step directory lacking its marker, falling back to the previous
+valid step instead of crashing.
 """
 
 import json
 import logging
+import os
 import re
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -31,6 +42,26 @@ from ..rl.buffer import ExperienceBuffer
 logger = logging.getLogger(__name__)
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_COMMIT_RE = re.compile(r"^step_(\d+)\.commit$")
+
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    """Write `text` to `path` via tmp + os.replace: readers see either
+    the old content or the new, never a torn half-write."""
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _fault_point(site: str, n: int) -> None:
+    """Fault-injection hook (supervise/faults.py). No-op unless armed
+    via ALPHATRIANGLE_FAULTS; the lazy import keeps the common path
+    free of any supervise dependency."""
+    if not os.environ.get("ALPHATRIANGLE_FAULTS"):
+        return
+    from ..supervise.faults import fault_point
+
+    fault_point(site, n)
 
 
 @dataclass
@@ -53,6 +84,13 @@ class CheckpointManager:
         self._ckpt_dir = persistence.get_checkpoint_dir().resolve()
         self._buffer_dir = persistence.get_buffer_dir().resolve()
         self._ckptr = ocp.StandardCheckpointer()
+        # Steps whose Orbax save has been dispatched but whose commit
+        # marker is not yet on disk (written once the async write lands).
+        # Guarded by the lock: the background flusher thread snapshots
+        # and clears it concurrently with `save()` adding to it.
+        self._pending_commits: set[int] = set()
+        self._commit_lock = threading.Lock()
+        self._flusher: threading.Thread | None = None
 
     # --- save -------------------------------------------------------------
 
@@ -67,7 +105,8 @@ class CheckpointManager:
 
         Multi-host discipline: EVERY process must call this (the Orbax
         save is a collective over the state's global arrays); the plain
-        file writes (meta.json, pruning) happen on process 0 only.
+        file writes (meta.json, commit markers, pruning) happen on
+        process 0 only.
         """
         path = self._ckpt_dir / f"step_{step:08d}"
         if path.exists():  # overwrite-safe for forced final saves
@@ -76,18 +115,80 @@ class CheckpointManager:
             # An async save of this step may still be in flight; let it
             # land before removing, or the writer races the rmtree.
             self._ckptr.wait_until_finished()
+            self._flush_commit_markers()
             if is_primary():
                 shutil.rmtree(path, ignore_errors=True)
+                self._commit_marker_path(step).unlink(missing_ok=True)
+        elif self._pending_commits:
+            # The previous async save had a full checkpoint cadence to
+            # land; settle it so its commit marker certifies the tree
+            # before a new save goes in flight.
+            self._ckptr.wait_until_finished()
+            self._flush_commit_markers()
         self._ckptr.save(path, train_state)
         if not is_primary():
             return path
         meta = {"global_step": step, **(counters or {})}
-        (self._ckpt_dir / f"step_{step:08d}.meta.json").write_text(
-            json.dumps(meta, indent=2)
+        _atomic_write_text(
+            self._ckpt_dir / f"step_{step:08d}.meta.json",
+            json.dumps(meta, indent=2),
         )
+        with self._commit_lock:
+            self._pending_commits.add(step)
+        _fault_point("checkpoint-save", step)
+        self._spawn_marker_flusher()
         logger.info("Checkpoint saved at step %d -> %s", step, path)
         self._prune_checkpoints(just_saved=step)
         return path
+
+    def _commit_marker_path(self, step: int) -> Path:
+        return self._ckpt_dir / f"step_{step:08d}.commit"
+
+    def _spawn_marker_flusher(self) -> None:
+        """Commit the in-flight save from a background thread as soon as
+        it lands. Without this the marker would wait for the NEXT
+        save/close to settle it, and a death between cadences would look
+        a whole cadence staler than it is (`cli supervise` reads the
+        markers to pick its restart point)."""
+        if self._flusher is not None and self._flusher.is_alive():
+            return  # the live flusher will settle everything pending
+        self._flusher = threading.Thread(
+            target=self._flush_after_wait,
+            name="ckpt-commit-flush",
+            daemon=True,
+        )
+        self._flusher.start()
+
+    def _flush_after_wait(self) -> None:
+        # Snapshot BEFORE waiting: steps added during the wait belong to
+        # a save dispatched after it started, which the wait does not
+        # prove landed.
+        with self._commit_lock:
+            steps = set(self._pending_commits)
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception:
+            logger.exception("Async checkpoint wait failed; markers unflushed")
+            return
+        self._flush_commit_markers(steps)
+
+    def _flush_commit_markers(self, steps: "set[int] | None" = None) -> None:
+        """Write commit markers for landed saves. Only call after
+        `wait_until_finished()`: the marker's existence certifies the
+        Orbax tree is fully on disk. `steps=None` flushes everything
+        pending (single-dispatcher callers that just waited)."""
+        with self._commit_lock:
+            if steps is None:
+                steps = set(self._pending_commits)
+            self._pending_commits -= steps
+        if not is_primary():
+            return
+        for step in sorted(steps):
+            if (self._ckpt_dir / f"step_{step:08d}").is_dir():
+                _atomic_write_text(
+                    self._commit_marker_path(step),
+                    json.dumps({"global_step": step}),
+                )
 
     def _prune_checkpoints(self, just_saved: int) -> None:
         keep = self.config.KEEP_LAST_CHECKPOINTS
@@ -110,6 +211,7 @@ class CheckpointManager:
         # Async writes to the survivors may be in flight; only the
         # doomed dirs matter, but Orbax tracks saves globally.
         self._ckptr.wait_until_finished()
+        self._flush_commit_markers()
         for step in steps[:-keep]:
             shutil.rmtree(
                 self._ckpt_dir / f"step_{step:08d}", ignore_errors=True
@@ -117,6 +219,7 @@ class CheckpointManager:
             (self._ckpt_dir / f"step_{step:08d}.meta.json").unlink(
                 missing_ok=True
             )
+            self._commit_marker_path(step).unlink(missing_ok=True)
             logger.debug("Pruned checkpoint step %d", step)
 
     def _prune_buffers(self) -> None:
@@ -140,9 +243,14 @@ class CheckpointManager:
         arrays = {f"storage_{k}": v for k, v in state["storage"].items()}
         if state["priorities"] is not None:
             arrays["priorities"] = state["priorities"]
+        # Atomic spill: the tmp name keeps the .npz suffix (np.savez
+        # appends it otherwise) but dodges the buffer_*.npz glob, so a
+        # kill mid-write never leaves a torn spill that restore trusts.
+        tmp = self._buffer_dir / f".tmp_buffer_{step:08d}.npz"
         np.savez_compressed(
-            path, pos=state["pos"], size=state["size"], **arrays
+            tmp, pos=state["pos"], size=state["size"], **arrays
         )
+        os.replace(tmp, path)
         logger.info("Buffer spilled (%d experiences) -> %s", state["size"], path)
         self._prune_buffers()
         return path
@@ -155,12 +263,14 @@ class CheckpointManager:
             k: (v.model_dump() if hasattr(v, "model_dump") else v)
             for k, v in configs.items()
         }
-        (self.config.get_run_base_dir() / "configs.json").write_text(
-            json.dumps(out, indent=2, default=str)
+        _atomic_write_text(
+            self.config.get_run_base_dir() / "configs.json",
+            json.dumps(out, indent=2, default=str),
         )
 
     def wait_until_finished(self) -> None:
         self._ckptr.wait_until_finished()
+        self._flush_commit_markers()
 
     def close(self) -> None:
         self.wait_until_finished()
@@ -180,8 +290,43 @@ class CheckpointManager:
             if p.is_dir() and (m := _STEP_DIR_RE.match(p.name))
         )
 
-    def latest_step(self) -> int | None:
+    def valid_steps(self) -> list[int]:
+        """Steps restore may trust: commit marker present (when this run
+        has markers at all — pre-marker runs fall back to meta-only
+        validation) and meta.json parseable. Torn directories from a
+        kill mid-save fail both tests and are skipped with a warning."""
         steps = self.list_steps()
+        if not steps:
+            return []
+        committed = {
+            int(m.group(1))
+            for p in self._ckpt_dir.glob("step_*.commit")
+            if (m := _COMMIT_RE.match(p.name))
+        }
+        valid: list[int] = []
+        for step in steps:
+            if committed and step not in committed:
+                logger.warning(
+                    "Checkpoint step %d has no commit marker (torn "
+                    "save?); skipping it for restore",
+                    step,
+                )
+                continue
+            meta_path = self._ckpt_dir / f"step_{step:08d}.meta.json"
+            try:
+                json.loads(meta_path.read_text())
+            except (OSError, ValueError):
+                logger.warning(
+                    "Checkpoint step %d has no parseable meta.json; "
+                    "skipping it for restore",
+                    step,
+                )
+                continue
+            valid.append(step)
+        return valid
+
+    def latest_step(self) -> int | None:
+        steps = self.valid_steps()
         return steps[-1] if steps else None
 
     def restore(
@@ -190,38 +335,73 @@ class CheckpointManager:
         step: int | None = None,
         buffer: ExperienceBuffer | None = None,
     ) -> LoadedTrainingState:
-        """Restore the checkpoint at `step` (default: latest).
+        """Restore the checkpoint at `step` (default: newest valid).
 
         `template_state` supplies the pytree structure/shapes (the
         freshly-initialized `TrainState`). Restores the buffer in place
         when a spill at <= step exists and `buffer` is given.
+
+        An explicit `step` is trusted (restore errors propagate). With
+        `step=None` the newest valid step is tried first and an
+        unreadable tree falls back to the previous valid step — a torn
+        directory costs one checkpoint cadence, never the run.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        if step is not None:
+            candidates = [step]
+            fallback = False
+        else:
+            candidates = list(reversed(self.valid_steps()))
+            fallback = True
+        if not candidates:
+            torn = self.list_steps()
+            if torn:
+                logger.warning(
+                    "No committed checkpoint among step dirs %s; "
+                    "starting fresh",
+                    torn,
+                )
             return LoadedTrainingState(run_name=self.config.RUN_NAME)
-        path = self._ckpt_dir / f"step_{step:08d}"
-        restored = self._ckptr.restore(path, target=template_state)
-        meta_path = self._ckpt_dir / f"step_{step:08d}.meta.json"
-        counters: dict[str, Any] = {}
-        if meta_path.exists():
-            counters = json.loads(meta_path.read_text())
-        buffer_loaded = False
-        if buffer is not None:
-            buffer_loaded = self.restore_buffer(buffer, max_step=step)
-        logger.info(
-            "Restored checkpoint step %d from %s (buffer=%s)",
-            step,
-            path,
-            buffer_loaded,
-        )
-        return LoadedTrainingState(
-            train_state=restored,
-            buffer_loaded=buffer_loaded,
-            counters=counters,
-            run_name=self.config.RUN_NAME,
-            global_step=int(counters.get("global_step", step)),
-        )
+        last_exc: Exception | None = None
+        for cand in candidates:
+            path = self._ckpt_dir / f"step_{cand:08d}"
+            try:
+                restored = self._ckptr.restore(path, target=template_state)
+            except Exception as exc:
+                if not fallback:
+                    raise
+                last_exc = exc
+                logger.warning(
+                    "Checkpoint step %d unreadable (%s); falling back "
+                    "to the previous valid step",
+                    cand,
+                    exc,
+                )
+                continue
+            meta_path = self._ckpt_dir / f"step_{cand:08d}.meta.json"
+            counters: dict[str, Any] = {}
+            if meta_path.exists():
+                try:
+                    counters = json.loads(meta_path.read_text())
+                except ValueError:
+                    counters = {}
+            buffer_loaded = False
+            if buffer is not None:
+                buffer_loaded = self.restore_buffer(buffer, max_step=cand)
+            logger.info(
+                "Restored checkpoint step %d from %s (buffer=%s)",
+                cand,
+                path,
+                buffer_loaded,
+            )
+            return LoadedTrainingState(
+                train_state=restored,
+                buffer_loaded=buffer_loaded,
+                counters=counters,
+                run_name=self.config.RUN_NAME,
+                global_step=int(counters.get("global_step", cand)),
+            )
+        assert last_exc is not None
+        raise last_exc
 
     def restore_path(
         self, path: str | Path, template_state: Any
@@ -257,7 +437,9 @@ class CheckpointManager:
     def restore_buffer(
         self, buffer: ExperienceBuffer, max_step: int | None = None
     ) -> bool:
-        """Load the newest buffer spill (optionally <= max_step) in place."""
+        """Load the newest buffer spill (optionally <= max_step) in
+        place. A torn spill (kill mid-write on a pre-atomic run) falls
+        back to the next-oldest instead of crashing the resume."""
         if not self._buffer_dir.exists():
             return False
         spills = sorted(self._buffer_dir.glob("buffer_*.npz"))
@@ -267,10 +449,18 @@ class CheckpointManager:
                 for s in spills
                 if int(s.stem.split("_")[1]) <= max_step
             ]
-        if not spills:
-            return False
-        self._load_spill_into(buffer, spills[-1])
-        return True
+        for spill in reversed(spills):
+            try:
+                self._load_spill_into(buffer, spill)
+                return True
+            except Exception as exc:
+                logger.warning(
+                    "Buffer spill %s unreadable (%s); falling back to "
+                    "the previous spill",
+                    spill.name,
+                    exc,
+                )
+        return False
 
     @staticmethod
     def _load_spill_into(buffer: ExperienceBuffer, path: Path) -> None:
@@ -294,8 +484,10 @@ class CheckpointManager:
 
     @staticmethod
     def find_latest_run(persistence: PersistenceConfig) -> str | None:
-        """Newest run (by checkpoint mtime) with at least one checkpoint
-        (reference auto-resume, `README.md:23`, `train_config.py:26`)."""
+        """Newest run (by checkpoint mtime) with at least one valid
+        checkpoint (reference auto-resume, `README.md:23`,
+        `train_config.py:26`). Runs whose only checkpoints are torn
+        (no commit marker where markers exist) are not candidates."""
         runs_root = persistence.get_runs_root_dir()
         if not runs_root.exists():
             return None
@@ -304,9 +496,17 @@ class CheckpointManager:
             ckpts = run_dir / "checkpoints"
             if not ckpts.is_dir():
                 continue
+            committed = {
+                int(m.group(1))
+                for p in ckpts.glob("step_*.commit")
+                if (m := _COMMIT_RE.match(p.name))
+            }
             steps = [
-                p for p in ckpts.iterdir()
-                if p.is_dir() and _STEP_DIR_RE.match(p.name)
+                p
+                for p in ckpts.iterdir()
+                if p.is_dir()
+                and (m := _STEP_DIR_RE.match(p.name))
+                and (not committed or int(m.group(1)) in committed)
             ]
             if steps:
                 candidates.append(

@@ -1,0 +1,31 @@
+"""Self-healing supervision: verdict-driven restarts + fault injection.
+
+JAX-free by contract (the parent must outlive a wedged chip). See
+docs/ROBUSTNESS.md for the policy matrix and fault knobs.
+"""
+
+from .policy import (
+    QUARANTINE_OVERRIDES,
+    Action,
+    RecoveryPolicy,
+)
+from .supervisor import (
+    OVERRIDES_ENV,
+    SUPERVISOR_FILENAME,
+    Supervisor,
+    diagnose,
+    latest_committed_step,
+    supervise_command,
+)
+
+__all__ = [
+    "Action",
+    "OVERRIDES_ENV",
+    "QUARANTINE_OVERRIDES",
+    "RecoveryPolicy",
+    "SUPERVISOR_FILENAME",
+    "Supervisor",
+    "diagnose",
+    "latest_committed_step",
+    "supervise_command",
+]

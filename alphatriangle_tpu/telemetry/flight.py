@@ -55,11 +55,23 @@ logger = logging.getLogger(__name__)
 FLIGHT_FILENAME = "flight.jsonl"
 WEDGE_REPORT_FILENAME = "wedge_report.json"
 WEDGE_STACKS_FILENAME = "wedge_stacks.txt"
+PREEMPT_REPORT_FILENAME = "preempt_report.json"
 
 # Distinct exit code for a dispatch-deadline wedge, chosen outside the
 # shell/signal ranges (1/2, 126-165): a supervisor seeing it KNOWS the
 # process killed itself over a hung device program, not a crash.
 WEDGE_EXIT_CODE = 113
+
+# Exit code for a SIGTERM preemption the training loop absorbed: the
+# emergency checkpoint + buffer spill + ledger flush all completed and
+# preempt_report.json is on disk. A supervisor seeing 114 restarts (or
+# doesn't — the host is being reclaimed) without treating it as a crash.
+PREEMPT_EXIT_CODE = 114
+
+# Exit code `cli supervise` uses when its restart budget / circuit
+# breaker trips: the child is sick in a way restarts don't fix, and the
+# caller (tpu_watch.sh) should stop burning window on it.
+SUPERVISOR_GIVEUP_EXIT_CODE = 115
 
 # Memory pressure at/above this fraction of the device limit makes the
 # doctor call a wedged/stalled run OOM rather than generically hung.
@@ -204,6 +216,13 @@ class FlightRecorder:
                 expected_s=expected,
                 avals=avals,
             )
+        if os.environ.get("ALPHATRIANGLE_FAULTS"):
+            # Fault-injection hook (supervise/faults.py): fires AFTER
+            # the intent is durable and the watchdog is armed, so an
+            # injected hang dies exactly like a real wedged dispatch.
+            from ..supervise.faults import fault_point
+
+            fault_point("dispatch", seq, flight_path=self.path)
         span = FlightSpan(self, seq, program, family, time.perf_counter())
         self.overhead_seconds += span.t0 - t_host
         return span
@@ -443,6 +462,16 @@ def read_wedge_report(path: Path | str) -> "dict | None":
         return None
 
 
+def write_preempt_report(path: Path | str, report: dict) -> bool:
+    """Atomic preempt-report write — same tmp+replace discipline (and
+    never-raises contract) as the wedge report."""
+    return write_wedge_report(path, report)
+
+
+def read_preempt_report(path: Path | str) -> "dict | None":
+    return read_wedge_report(path)
+
+
 def unsealed_intents(records: list) -> list[dict]:
     """Intent records with no seal (any outcome) for their seq — the
     dispatches that were in flight when the process died."""
@@ -522,6 +551,7 @@ DOCTOR_EXIT_CODES = {
     "dispatch-hung": 4,
     "host-stall": 5,
     "oom": 6,
+    "preempted": 7,
 }
 
 
@@ -545,6 +575,7 @@ def classify_run(
     utils: "list | None" = None,
     wedge: "dict | None" = None,
     now: "float | None" = None,
+    preempt: "dict | None" = None,
 ) -> dict:
     """Pure postmortem classifier over a run's on-disk evidence.
 
@@ -559,6 +590,9 @@ def classify_run(
     - `host-stall`: every dispatch sealed but the heartbeat says the
       process stalled (or kept beating long after the last seal) — the
       device finished its work and the HOST stopped feeding it.
+    - `preempted`: a preempt report is on disk — the loop absorbed a
+      SIGTERM, emergency-checkpointed, and exited on purpose. Only a
+      hang outranks it (a wedge mid-preemption is still a wedge).
     - `never-started`: no dispatch was ever attempted (no flight
       records) — death before the first dispatch (imports, init,
       checkpoint restore).
@@ -580,6 +614,7 @@ def classify_run(
         "unsealed": len(torn),
         "mem_utilization": pressure,
         "wedge_report": wedge is not None,
+        "preempt_report": preempt is not None,
         "stalled": bool((health or {}).get("stalled")),
     }
 
@@ -629,6 +664,14 @@ def classify_run(
             else "compile-hung"
         )
         return result(verdict, program, family, detail)
+    if preempt is not None:
+        ckpt = preempt.get("checkpointed_step")
+        return result(
+            "preempted",
+            detail="preempt report: SIGTERM absorbed at step "
+            f"{preempt.get('step')}, emergency checkpoint at step "
+            f"{ckpt} — restart resumes there",
+        )
     if not records:
         return result(
             "never-started",

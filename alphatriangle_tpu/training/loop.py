@@ -29,6 +29,7 @@ weight sync every `WORKER_UPDATE_FREQ_STEPS` learner steps
 """
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -51,6 +52,10 @@ class LoopStatus(str, Enum):
     COMPLETED = "completed"
     STOPPED = "stopped"
     ERROR = "error"
+    # SIGTERM absorbed: emergency checkpoint + buffer spill + telemetry
+    # flush all ran; the runner exits PREEMPT_EXIT_CODE (114) so a
+    # supervisor distinguishes a survivable preemption from a crash.
+    PREEMPTED = "preempted"
 
 
 class TrainingLoop:
@@ -60,6 +65,7 @@ class TrainingLoop:
         self.c = components
         self.cfg = components.train_config
         self.stop_event = threading.Event()
+        self._preempt_requested = False
         # Device-resident replay (rl/device_buffer.py): rollout payloads
         # stay on device and training batches are gathered there; the
         # loop moves only indices, counts and metrics over the link.
@@ -136,6 +142,41 @@ class TrainingLoop:
                 self.cfg.FUSED_LEARNER_STEPS,
                 self.cfg.WORKER_UPDATE_FREQ_STEPS,
             )
+
+    # --- preemption -------------------------------------------------------
+
+    def request_preempt(self) -> None:
+        """Ask the loop to stop for a preemption (SIGTERM): every mode
+        checks `stop_event` per beat, so the loop falls through to the
+        `run()` finally — emergency checkpoint, buffer spill, ledger/
+        flight flush — then reports PREEMPTED instead of COMPLETED.
+        Signal-handler safe (a bool + Event.set, no locks)."""
+        self._preempt_requested = True
+        self.stop_event.set()
+
+    def _write_preempt_report(self) -> None:
+        """Atomic preempt_report.json: the evidence `cli doctor` and
+        the supervisor classify a 114 exit on. Written AFTER the
+        emergency checkpoint so `checkpointed_step` is the step a
+        restart actually resumes from."""
+        from ..telemetry.flight import (
+            PREEMPT_EXIT_CODE,
+            PREEMPT_REPORT_FILENAME,
+            write_preempt_report,
+        )
+
+        run_dir = self.c.persistence_config.get_run_base_dir()
+        write_preempt_report(
+            run_dir / PREEMPT_REPORT_FILENAME,
+            {
+                "kind": "preempt",
+                "time": time.time(),
+                "pid": os.getpid(),
+                "step": self.global_step,
+                "checkpointed_step": self._last_saved_step,
+                "exit_code": PREEMPT_EXIT_CODE,
+            },
+        )
 
     # --- resume -----------------------------------------------------------
 
@@ -358,6 +399,13 @@ class TrainingLoop:
                 "Loss/Entropy": metrics["entropy"],
             },
         )
+        if os.environ.get("ALPHATRIANGLE_FAULTS"):
+            # Chaos-harness hook (supervise/faults.py): step-indexed
+            # faults (sigterm/sigkill/crash at step N) fire here, after
+            # the step's bookkeeping is complete.
+            from ..supervise.faults import fault_point
+
+            fault_point("step", step)
 
     def _maybe_sync_weights(self, prev_step: int) -> None:
         """Push learner params when (prev_step, global_step] crossed a
@@ -598,6 +646,16 @@ class TrainingLoop:
             except Exception:
                 logger.exception("Final save failed.")
                 status = LoopStatus.ERROR
+            if self._preempt_requested:
+                if status is not LoopStatus.ERROR:
+                    status = LoopStatus.PREEMPTED
+                self._write_preempt_report()
+                logger.warning(
+                    "Preempted at step %d (emergency checkpoint at "
+                    "step %s); exiting for restart.",
+                    self.global_step,
+                    self._last_saved_step,
+                )
             # Last: the final heartbeat + span-trace export cover the
             # shutdown work above too.
             try:

@@ -7,7 +7,11 @@ shutdown, actor kill fallbacks, MLflow bootstrapping (TensorBoard only
 in this environment).
 """
 
+import json
 import logging
+import os
+import signal
+import threading
 
 from ..config.env_config import EnvConfig
 from ..config.mcts_config import MCTSConfig
@@ -23,6 +27,7 @@ from ..parallel.distributed import (
     is_primary,
 )
 from ..stats.persistence import CheckpointManager
+from ..telemetry.flight import PREEMPT_EXIT_CODE
 from ..utils.helpers import (
     enable_persistent_compilation_cache,
     enforce_platform,
@@ -36,7 +41,68 @@ EXIT_CODES = {
     LoopStatus.COMPLETED: 0,
     LoopStatus.STOPPED: 0,
     LoopStatus.ERROR: 1,
+    LoopStatus.PREEMPTED: PREEMPT_EXIT_CODE,
 }
+
+#: JSON env var of TrainConfig field overrides injected by
+#: `cli supervise` (supervise/supervisor.py OVERRIDES_ENV): the
+#: recovery policy's degraded/quarantined knobs reach the child here,
+#: regardless of which CLI flags spawned it. `<FIELD>__scale` keys
+#: multiply the current value (min 1) instead of replacing it.
+SUPERVISE_OVERRIDES_ENV = "ALPHATRIANGLE_SUPERVISE_OVERRIDES"
+
+
+def _apply_supervise_overrides(train_config: TrainConfig) -> TrainConfig:
+    raw = os.environ.get(SUPERVISE_OVERRIDES_ENV)
+    if not raw:
+        return train_config
+    try:
+        overrides = json.loads(raw)
+    except ValueError:
+        logger.warning(
+            "Unparseable %s=%r; ignoring.", SUPERVISE_OVERRIDES_ENV, raw
+        )
+        return train_config
+    if not isinstance(overrides, dict) or not overrides:
+        return train_config
+    resolved: dict = {}
+    for key, value in overrides.items():
+        if key.endswith("__scale"):
+            field = key[: -len("__scale")]
+            current = getattr(train_config, field)
+            resolved[field] = max(1, round(current * float(value)))
+        else:
+            resolved[key] = value
+    logger.warning(
+        "Supervisor recovery overrides active: %s", resolved
+    )
+    # Rebuild through the constructor so pydantic validation runs
+    # (mirrors cli.merge_train_overrides) and derived schedule lengths
+    # stay untouched — the horizon is not a recovery knob.
+    base = train_config.model_dump()
+    base.update(resolved)
+    return TrainConfig(**base)
+
+
+def _install_preempt_handler(loop: TrainingLoop):
+    """Route SIGTERM into `loop.request_preempt()` (main thread only —
+    signal.signal raises elsewhere, and library callers embedding
+    run_training in a thread keep their own handling). Returns a
+    restore callback. SIGINT keeps its KeyboardInterrupt semantics
+    (exit 0, reference behavior); SIGTERM is the preemption contract."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+
+    def _on_sigterm(signum, frame):
+        logger.warning(
+            "SIGTERM received: preempting (emergency checkpoint, then "
+            "exit %d).",
+            PREEMPT_EXIT_CODE,
+        )
+        loop.request_preempt()
+
+    previous = signal.signal(signal.SIGTERM, _on_sigterm)
+    return lambda: signal.signal(signal.SIGTERM, previous)
 
 
 def _resolve_auto_resume(
@@ -78,6 +144,7 @@ def run_training(
     is actually runnable on this backend (`cli train --dry-setup`)."""
     setup_logging(log_level)
     train_config = train_config or TrainConfig()
+    train_config = _apply_supervise_overrides(train_config)
     # Must precede any backend init (a site hook can override the env
     # var and point a CPU-intended run at a possibly-wedged TPU).
     enforce_platform(train_config.DEVICE)
@@ -177,7 +244,11 @@ def run_training(
         )
         return 1
 
-    status = loop.run()
+    restore_handler = _install_preempt_handler(loop)
+    try:
+        status = loop.run()
+    finally:
+        restore_handler()
     components.stats.close()
     components.checkpoints.close()
     logger.info("Training finished: %s", status.value)

@@ -19,6 +19,13 @@
 #   WATCH_BUDGET_S  total wall budget (default 6h)
 #   WATCH_CMD       command to run in a healthy window
 #                   (default: bash benchmarks/tpu_round4.sh)
+#   WATCH_RUN       when set (and WATCH_CMD is not), the window runs a
+#                   SUPERVISED training run of this name instead of the
+#                   sweep: `cli supervise --run-name $WATCH_RUN -- train`
+#                   (docs/ROBUSTNESS.md). The supervisor self-heals
+#                   in-window deaths (verdict-driven restarts from the
+#                   latest committed checkpoint); only exhausted budgets
+#                   (exit 115) or preemption (114) end the window.
 #   WATCH_WARM_S    budget for the post-probe compile-cache warm
 #                   (default 900; 0 disables warming)
 #   WATCH_TUNE_S    budget for the offline autotune step (default 600;
@@ -30,7 +37,12 @@
 set -u
 cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + ${WATCH_BUDGET_S:-21600} ))
-cmd=${WATCH_CMD:-"bash benchmarks/tpu_round4.sh"}
+if [ -n "${WATCH_RUN:-}" ]; then
+  default_cmd="python -m alphatriangle_tpu.cli supervise --run-name ${WATCH_RUN} -- train"
+else
+  default_cmd="bash benchmarks/tpu_round4.sh"
+fi
+cmd=${WATCH_CMD:-"$default_cmd"}
 warm_s=${WATCH_WARM_S:-900}
 tune_s=${WATCH_TUNE_S:-600}
 runs_root=.alphatriangle_data/AlphaTriangleTPU/runs
@@ -64,8 +76,15 @@ archive_window() {
   dest="$runs_root/_windows/$ts"
   mkdir -p "$dest"
   for f in flight.jsonl flight.jsonl.1 health.json wedge_report.json \
-           wedge_stacks.txt stall_stacks.txt trace.json; do
+           wedge_stacks.txt stall_stacks.txt trace.json \
+           supervisor.jsonl preempt_report.json; do
     [ -f "$run_dir/$f" ] && cp "$run_dir/$f" "$dest/" 2>/dev/null
+  done
+  # Per-attempt report archives a supervised window's restarts left
+  # behind (wedge_report.json.attempt2, ...): the death->verdict->
+  # restart chain's evidence, kept beside supervisor.jsonl.
+  for f in "$run_dir"/*.attempt*; do
+    [ -f "$f" ] && cp "$f" "$dest/" 2>/dev/null
   done
   # JAX-free postmortem: names the program the window died inside.
   verdict=$(timeout 60 python -m alphatriangle_tpu.cli doctor "$run_dir" --json 2>/dev/null)
@@ -123,6 +142,18 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       # wedge, reclassified here instead of lost to a silent hang.
       echo "$(date +%T) command wedged (dispatch watchdog, exit 113); back to probing" >&2
       archive_window "cmd-wedged"
+    elif [ "$rc" -eq 114 ]; then
+      # Preemption absorbed: the loop emergency-checkpointed and exited
+      # on purpose (docs/ROBUSTNESS.md). The next healthy window's
+      # restart resumes from that checkpoint.
+      echo "$(date +%T) command preempted (exit 114, emergency checkpoint on disk); back to probing" >&2
+      archive_window "cmd-preempted"
+    elif [ "$rc" -eq 115 ]; then
+      # `cli supervise` exhausted its restart budget / tripped the
+      # circuit breaker: the chip (or config) is persistently sick.
+      # Back to probing — a later window may find a healthy chip.
+      echo "$(date +%T) supervisor gave up (exit 115); back to probing" >&2
+      archive_window "supervisor-gave-up"
     else
       echo "$(date +%T) command aborted (rc=$rc); back to probing" >&2
       archive_window "cmd-aborted"

@@ -371,10 +371,76 @@ class TestUntrackedRng:
         assert "untracked-rng" in rules_hit(r)
 
 
+# --- rule: untrapped-exit --------------------------------------------------
+
+
+class TestUntrappedExit:
+    def test_true_positive_hot_path(self, tmp_path):
+        src = """
+            import sys
+
+            def bail(metrics):
+                if metrics["loss"] != metrics["loss"]:
+                    sys.exit(1)
+        """
+        r = lint_tree(tmp_path, {"rl/bail.py": src})
+        hits = [f for f in r.findings if f.rule == "untrapped-exit"]
+        assert len(hits) == 1
+        assert "sys.exit" in hits[0].message
+
+    def test_true_positive_training_os_exit(self, tmp_path):
+        src = """
+            import os
+
+            def hard_stop():
+                os._exit(3)
+        """
+        r = lint_tree(tmp_path, {"training/stop.py": src})
+        assert "untrapped-exit" in rules_hit(r)
+
+    def test_true_negative_cold_module(self, tmp_path):
+        # Same code in a cold dir: CLI-ish exits outside the hot path /
+        # training loop are not this rule's business.
+        src = """
+            import sys
+
+            def bail():
+                sys.exit(1)
+        """
+        r = lint_tree(tmp_path, {"stats/report.py": src})
+        assert "untrapped-exit" not in rules_hit(r)
+
+    def test_whitelist_sanctioned_exiters(self, tmp_path):
+        # The dispatch watchdog (os._exit is the point — the thread that
+        # would run shutdown is the wedged one) and the supervisor own
+        # process lifecycle; they stay clean even if their dirs are ever
+        # promoted into the hot-path set.
+        src = """
+            import os, sys
+
+            def die():
+                os._exit(113)
+
+            def give_up():
+                sys.exit(115)
+        """
+        r = lint_tree(
+            tmp_path,
+            {"supervise/supervisor.py": src, "telemetry/flight.py": src},
+        )
+        assert "untrapped-exit" not in rules_hit(r)
+
+
 # --- engine: pragmas, baseline, exit codes --------------------------------
 
 
 ONE_PER_RULE = {
+    "training/exit.py": """
+        import sys
+
+        def f(step):
+            sys.exit(1)
+    """,
     "rl/donation.py": DONATION_BAD,
     "rl/mixed.py": MIXED_BAD,
     "rl/dispatch.py": UNBRACKETED_BAD,

@@ -361,6 +361,154 @@ class TestCheckpointManager:
         assert data["note"] == "x"
 
 
+class TestCheckpointIntegrity:
+    """Crash-integrity contract (docs/ROBUSTNESS.md): commit markers
+    certify fully-landed Orbax trees; restore never trusts a torn one."""
+
+    def _trainer(self, tiny_model_config, tiny_env_config, tiny_train_config):
+        net = NeuralNetwork(tiny_model_config, tiny_env_config, seed=0)
+        return Trainer(net, tiny_train_config)
+
+    def test_commit_marker_lands_without_explicit_wait(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        """The background flusher commits a save as soon as the async
+        write finishes — `cli supervise` reads the markers at death
+        time, so they must not wait for the NEXT save to settle them."""
+        import time
+
+        trainer = self._trainer(
+            tiny_model_config, tiny_env_config, tiny_train_config
+        )
+        cfg = per_cfg(tmp_path)
+        mgr = CheckpointManager(cfg)
+        mgr.save(1, trainer.state)
+        marker = cfg.get_checkpoint_dir() / "step_00000001.commit"
+        deadline = time.monotonic() + 30.0
+        while not marker.exists() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert marker.exists(), "commit marker never flushed in background"
+        mgr.close()
+
+    def test_restore_skips_step_without_commit_marker(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        """A SIGKILL mid-save leaves a step dir with no marker: restore
+        must fall back to the previous committed step, not crash and
+        not trust the torn tree."""
+        import json
+
+        trainer = self._trainer(
+            tiny_model_config, tiny_env_config, tiny_train_config
+        )
+        cfg = per_cfg(tmp_path)
+        mgr = CheckpointManager(cfg)
+        mgr.save(1, trainer.state)
+        mgr.save(2, trainer.state)
+        mgr.wait_until_finished()
+        # Forge the torn artifact: a half-written step-3 tree + meta,
+        # killed before its commit marker.
+        ckpts = cfg.get_checkpoint_dir()
+        torn = ckpts / "step_00000003"
+        torn.mkdir()
+        (torn / "partial_array").write_bytes(b"\x00\x01garbage")
+        (ckpts / "step_00000003.meta.json").write_text(
+            json.dumps({"global_step": 3})
+        )
+        assert mgr.valid_steps() == [1, 2]
+        assert mgr.latest_step() == 2
+        loaded = mgr.restore(trainer.state)
+        assert loaded.global_step == 2
+        mgr.close()
+
+    def test_restore_skips_unparseable_meta(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        trainer = self._trainer(
+            tiny_model_config, tiny_env_config, tiny_train_config
+        )
+        cfg = per_cfg(tmp_path)
+        mgr = CheckpointManager(cfg)
+        mgr.save(1, trainer.state)
+        mgr.save(2, trainer.state)
+        mgr.wait_until_finished()
+        (cfg.get_checkpoint_dir() / "step_00000002.meta.json").write_text(
+            "{torn mid-write"
+        )
+        assert mgr.valid_steps() == [1]
+        loaded = mgr.restore(trainer.state)
+        assert loaded.global_step == 1
+        mgr.close()
+
+    def test_restore_falls_back_when_committed_tree_unreadable(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        """Belt and braces: even a MARKED step whose tree turns out
+        unreadable (disk fault) costs one cadence, not the run. An
+        explicitly requested step still raises."""
+        import shutil
+
+        trainer = self._trainer(
+            tiny_model_config, tiny_env_config, tiny_train_config
+        )
+        cfg = per_cfg(tmp_path)
+        mgr = CheckpointManager(cfg)
+        mgr.save(1, trainer.state)
+        mgr.save(2, trainer.state)
+        mgr.wait_until_finished()
+        step2 = cfg.get_checkpoint_dir() / "step_00000002"
+        shutil.rmtree(step2)
+        step2.mkdir()  # marker present, tree gutted
+        loaded = mgr.restore(trainer.state)
+        assert loaded.global_step == 1
+        with pytest.raises(Exception):
+            mgr.restore(trainer.state, step=2)
+        mgr.close()
+
+    def test_restore_buffer_falls_back_past_torn_spill(self, tmp_path):
+        from tests.test_buffer import make_dense
+
+        tc = TrainConfig(
+            BATCH_SIZE=4, BUFFER_CAPACITY=64, MIN_BUFFER_SIZE_TO_TRAIN=8,
+            USE_PER=False, MAX_TRAINING_STEPS=10, RUN_NAME="t",
+        )
+        buf = ExperienceBuffer(tc)
+        buf.add_dense(*make_dense(10))
+        cfg = per_cfg(tmp_path)
+        mgr = CheckpointManager(cfg)
+        mgr.save_buffer(3, buf)
+        # A newer spill torn by a kill mid-write (pre-atomic artifact).
+        (cfg.get_buffer_dir() / "buffer_00000009.npz").write_bytes(
+            b"PK\x03\x04 torn"
+        )
+        buf2 = ExperienceBuffer(tc)
+        assert mgr.restore_buffer(buf2)
+        assert len(buf2) == 10
+
+    def test_find_latest_run_ignores_torn_only_runs(
+        self, tmp_path, tiny_model_config, tiny_env_config, tiny_train_config
+    ):
+        import time
+
+        trainer = self._trainer(
+            tiny_model_config, tiny_env_config, tiny_train_config
+        )
+        mgr_a = CheckpointManager(per_cfg(tmp_path, "run_good"))
+        mgr_a.save(1, trainer.state)
+        mgr_a.close()
+        time.sleep(0.05)
+        # run_torn is NEWER but its only step dir has no commit marker
+        # (its single marker names a step whose dir is gone).
+        cfg_t = per_cfg(tmp_path, "run_torn")
+        cfg_t.create_run_dirs()
+        ckpts = cfg_t.get_checkpoint_dir()
+        (ckpts / "step_00000002").mkdir()
+        (ckpts / "step_00000001.commit").write_text('{"global_step": 1}')
+        assert (
+            CheckpointManager.find_latest_run(per_cfg(tmp_path)) == "run_good"
+        )
+
+
 class _MlflowStub:
     """In-memory mlflow facade: records every mirror call the collector
     makes, so the MLflow channel is pinned even on images where mlflow
