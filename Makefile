@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke
 
 check: lint type test
 
@@ -101,6 +101,16 @@ doctor-smoke:
 # The supervisor parent runs with jax imports hard-blocked.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos_smoke.py
+
+# Serve-fleet gate (docs/SERVING.md "Fleet"): a loadgen storm through
+# `cli fleet --smoke` (2 replica subprocesses behind the least-queue-
+# depth router, jax-free parent) must survive a mid-storm SIGKILL, an
+# injected hang-serve wedge (watchdog 113 -> dispatch-hung -> respawn
+# on a halved bucket -> re-admission, the chain in fleet.jsonl), and a
+# rolling weight reload with zero recompiles — with ZERO lost requests
+# (completed + shed == requests) and p95 move latency inside the SLO.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_smoke.py
 
 # Kernel-library gate (docs/KERNELS.md): every interchangeable lowering
 # in alphatriangle_tpu/ops/ (gather_rows, backup_update, per_sample)

@@ -25,10 +25,11 @@ from .dataflow import (
 )
 from .model import Finding, Module
 
-# The four dispatch families PR 10 instrumented: every dispatch of one
-# of these MUST sit inside a FlightRecorder intent/seal bracket, or a
-# wedge inside it is invisible to `cli doctor`.
-FLIGHT_FAMILIES = ("rollout", "learner", "megastep", "serve")
+# The dispatch families PR 10 instrumented (plus the fleet router's
+# host-side route bracket): every dispatch of one of these MUST sit
+# inside a FlightRecorder intent/seal bracket, or a wedge inside it is
+# invisible to `cli doctor`.
+FLIGHT_FAMILIES = ("rollout", "learner", "megastep", "serve", "fleet")
 
 _NP_FETCH = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _JIT_TAILS = (".jit", ".pjit")

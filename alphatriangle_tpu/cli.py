@@ -440,6 +440,19 @@ def cmd_health(args: argparse.Namespace) -> int:
     run_dir = _resolve_run_dir(args.run, args.root_dir)
     if run_dir is None:
         return 2
+    if getattr(args, "probe", False):
+        # Machine mode (docs/OBSERVABILITY.md "Probe"): ONE JSON line +
+        # the probe exit-code contract (0 live / 1 stalled-or-stale /
+        # 2 missing / 3 unsealed dispatch past deadline). The same
+        # implementation the fleet router's admission gate uses, so
+        # external orchestrators and the fleet agree on readiness.
+        import json as _json
+
+        from .telemetry.health import probe_run
+
+        result = probe_run(run_dir, deadline_s=args.deadline)
+        print(_json.dumps(result))
+        return int(result["code"])
     path = run_dir / "health.json"
     payload = read_health(path)
     if payload is None:
@@ -650,6 +663,19 @@ def cmd_perf(args: argparse.Namespace) -> int:
     league = summarize_league(read_ledger(ledger, kinds={"league"}))
     if league is not None:
         summary.update(league)
+    # Fleet fold (serving/fleet.py fleet.jsonl decision ledger, beside
+    # the metrics ledger): fleet runs gain the fleet_* fields and the
+    # fleet line below.
+    from .telemetry.perf import summarize_fleet
+
+    fleet_path = ledger.parent / "fleet.jsonl"
+    fleet = (
+        summarize_fleet(read_ledger(fleet_path))
+        if fleet_path.is_file()
+        else None
+    )
+    if fleet is not None:
+        summary.update(fleet)
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -725,6 +751,20 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   stale dropped {_fmt_cell(summary.get('league_stale_dropped'), ',.0f')}"
             f"   promotions {_fmt_cell(summary.get('league_promotions'), ',.0f')}"
             f"   live elo {_fmt_cell(summary.get('league_live_elo'), ',.1f')}"
+        )
+    if fleet is not None:
+        # Fleet churn + storm SLOs (serving/fleet.py; fleet.jsonl):
+        # latency is end-to-end as the router saw it, retries/hedges
+        # included.
+        print(
+            f"  fleet        move p50 {_fmt_cell(summary.get('fleet_move_latency_ms_p50'), ',.1f', 1, 'ms')}"
+            f"   p95 {_fmt_cell(summary.get('fleet_move_latency_ms_p95'), ',.1f', 1, 'ms')}"
+            f"   {_fmt_cell(summary.get('fleet_requests_per_sec'), ',.1f')} req/s"
+            f"   deaths {_fmt_cell(summary.get('fleet_deaths'), ',.0f')}"
+            f"   respawns {_fmt_cell(summary.get('fleet_respawns'), ',.0f')}"
+            f"   readmits {_fmt_cell(summary.get('fleet_readmissions'), ',.0f')}"
+            f"   sheds {_fmt_cell(summary.get('fleet_sheds'), ',.0f')}"
+            f"   lost {_fmt_cell(summary.get('fleet_lost'), ',.0f')}"
         )
     if programs:
         # Measured per-program device time (flight recorder seals) —
@@ -1541,6 +1581,165 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ok = report["sessions_served"] >= args.sessions and (
             run_dir / "metrics.jsonl"
         ).exists()
+        return 0 if ok else 1
+    return 0
+
+
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """Fault-tolerant serve fleet (docs/SERVING.md "Fleet"): N
+    PolicyService replica subprocesses behind a least-queue-depth
+    router with health-gated admission, per-request timeout + retry
+    onto a different replica, optional hedging, and bounded-queue load
+    shedding. Replica lifecycle reuses the training supervisor's
+    machinery — deaths are doctor-classified since spawn, restarted
+    with backoff under a restart budget (a serve-family quarantine
+    respawns onto a halved bucket), and every lifecycle/routing
+    decision lands crash-safe in the run's fleet.jsonl.
+
+    THIS PARENT NEVER IMPORTS JAX — the same contract as `cli
+    supervise`/`cli doctor` (benchmarks/fleet_smoke.py pins it with an
+    import guard). JAX lives in the replica children
+    (`python -m alphatriangle_tpu.serving.replica`), one compiled
+    `serve/b<B>` program each.
+
+    Drives a storm of episode requests through the router and prints
+    one JSON report line; `--smoke` additionally gates on the
+    zero-lost-requests invariant. `--chaos-kill-after N` /
+    `--reload-after N` are the smoke's mid-storm chaos/rolling-swap
+    triggers.
+    """
+    import json as _json
+    import threading as _threading
+    import time as _time
+
+    from .serving.fleet import FleetSupervisor, run_fleet_load
+    from .supervise.policy import RecoveryPolicy
+
+    run_dir = _resolve_run_dir(args.run_name, args.root_dir)
+    if run_dir is None:
+        return 2
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    def policy_factory() -> RecoveryPolicy:
+        return RecoveryPolicy(
+            max_restarts=args.max_restarts,
+            circuit_breaker_deaths=args.circuit_breaker,
+            backoff_base_s=args.backoff_base,
+            backoff_max_s=args.backoff_max,
+            quarantine_after=args.quarantine_after,
+        )
+
+    replica_extra = [
+        "--health-interval",
+        str(args.replica_health_interval),
+        "--dispatch-min-deadline",
+        str(args.replica_dispatch_min_deadline),
+        "--dispatch-first-deadline",
+        str(args.replica_dispatch_first_deadline),
+        "--dispatch-watchdog-poll",
+        str(args.replica_watchdog_poll),
+        "--tick-every",
+        str(args.tick_every),
+    ]
+    fleet = FleetSupervisor(
+        run_dir,
+        replicas=args.replicas,
+        slots=args.slots,
+        sims=args.sims,
+        seed=args.seed,
+        configs_dir=run_dir,
+        replica_extra_argv=replica_extra,
+        policy_factory=policy_factory,
+        probe_deadline_s=args.probe_deadline,
+        poll_s=args.poll,
+        spawn_timeout_s=args.spawn_timeout,
+    )
+    router = fleet.build_router(
+        timeout_s=args.timeout,
+        retries=args.retries,
+        backoff_base_s=args.route_backoff_base,
+        backoff_max_s=args.route_backoff_max,
+        hedge_after_s=args.hedge_after,
+        max_inflight=args.max_queue,
+    )
+
+    chaos_lock = _threading.Lock()
+    state = {"killed": False, "reload": None}
+
+    def on_complete(n: int) -> None:
+        with chaos_lock:
+            kill_now = (
+                args.chaos_kill_after > 0
+                and not state["killed"]
+                and n >= args.chaos_kill_after
+            )
+            if kill_now:
+                state["killed"] = True
+            reload_now = (
+                args.reload_after > 0
+                and state["reload"] is None
+                and n >= args.reload_after
+            )
+            if reload_now:
+                state["reload"] = _threading.Thread(
+                    target=fleet.rolling_reload,
+                    name="fleet-reload",
+                    daemon=True,
+                )
+        if kill_now:
+            victim = fleet.kill_replica()
+            print(f"fleet: chaos-killed {victim}", file=sys.stderr)
+        if reload_now:
+            state["reload"].start()
+
+    print(
+        f"fleet: {args.replicas} replicas x {args.slots} slots, "
+        f"{args.requests} requests, run dir {run_dir}",
+        file=sys.stderr,
+    )
+    try:
+        fleet.start()
+        storm = run_fleet_load(
+            router,
+            fleet,
+            requests=args.requests,
+            concurrency=args.concurrency,
+            max_moves=args.max_moves,
+            seed=args.seed,
+            timeout_s=args.timeout,
+            on_complete=on_complete,
+        )
+        if state["reload"] is not None:
+            state["reload"].join(timeout=180.0)
+        # Let pending respawn chains settle so the death -> verdict ->
+        # respawn -> readmit sequence completes on fleet.jsonl before
+        # the report (and the smoke's ledger assertions) read it.
+        deadline = _time.monotonic() + args.settle
+        while _time.monotonic() < deadline:
+            if all(
+                h.name in fleet.gaveup or h.routable for h in fleet.handles
+            ):
+                break
+            _time.sleep(0.2)
+    finally:
+        fleet.stop()
+
+    report = {
+        "schema": "alphatriangle.fleet.v1",
+        "run": args.run_name or run_dir.name,
+        "replicas": args.replicas,
+        "slots": args.slots,
+        **storm,
+        "fleet": fleet.summary(),
+        "ledger": str(run_dir / "fleet.jsonl"),
+    }
+    print(_json.dumps(report))
+    if args.smoke:
+        accounted = (
+            storm["completed"] + storm["shed"] == storm["terminal"]
+            and storm["terminal"] == storm["requests"]
+        )
+        ok = storm["lost"] == 0 and storm["completed"] > 0 and accounted
         return 0 if ok else 1
     return 0
 
@@ -2428,6 +2627,14 @@ def main(argv: list[str] | None = None) -> int:
         help="Staleness deadline override (default: the run's "
         "watchdog deadline).",
     )
+    health.add_argument(
+        "--probe",
+        action="store_true",
+        help="Machine mode: one JSON line + exit-code contract "
+        "(0 live / 1 stalled / 2 missing / 3 dispatch-overdue) — the "
+        "probe the fleet router and external orchestrators share "
+        "(docs/OBSERVABILITY.md).",
+    )
 
     perf = sub.add_parser(
         "perf",
@@ -2707,6 +2914,162 @@ def main(argv: list[str] | None = None) -> int:
     )
     serve.add_argument(
         "--device", default=None, choices=["auto", "tpu", "cpu"]
+    )
+
+    fleet = sub.add_parser(
+        "fleet",
+        help="Fault-tolerant serve fleet: N PolicyService replica "
+        "subprocesses behind a health-gated least-queue-depth router "
+        "with retry/hedge/shed, verdict-driven replica restarts, and "
+        "a crash-safe fleet.jsonl decision ledger (docs/SERVING.md "
+        "'Fleet'). The parent never imports JAX.",
+    )
+    fleet.add_argument(
+        "--run-name",
+        default="fleet",
+        help="Fleet run dir name (replica run dirs nest inside; a "
+        "configs.json there supplies the board/net).",
+    )
+    fleet.add_argument("--root-dir", default=None)
+    fleet.add_argument("--replicas", type=int, default=2, metavar="N")
+    fleet.add_argument(
+        "--slots",
+        type=int,
+        default=8,
+        metavar="B",
+        help="Session slots per replica = its compiled serve/b<B> "
+        "bucket (a quarantined replica respawns onto half).",
+    )
+    fleet.add_argument("--sims", type=int, default=4)
+    fleet.add_argument("--seed", type=int, default=0)
+    fleet.add_argument(
+        "--requests",
+        type=int,
+        default=32,
+        metavar="N",
+        help="Episode requests in the storm.",
+    )
+    fleet.add_argument("--concurrency", type=int, default=8)
+    fleet.add_argument("--max-moves", type=int, default=12)
+    fleet.add_argument(
+        "--timeout",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="Per-attempt request timeout (a timed-out attempt "
+        "retries on a different replica).",
+    )
+    fleet.add_argument(
+        "--retries",
+        type=int,
+        default=2,
+        help="Retry budget per request after the first attempt.",
+    )
+    fleet.add_argument(
+        "--route-backoff-base", type=float, default=0.1, metavar="SECONDS"
+    )
+    fleet.add_argument(
+        "--route-backoff-max", type=float, default=2.0, metavar="SECONDS"
+    )
+    fleet.add_argument(
+        "--hedge-after",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="Hedge a straggling request onto a second replica after "
+        "this long; first result wins (default: off).",
+    )
+    fleet.add_argument(
+        "--max-queue",
+        type=int,
+        default=64,
+        metavar="N",
+        help="Bounded admission: in-flight requests past this are "
+        "shed with rejection code 'queue-full'.",
+    )
+    fleet.add_argument(
+        "--probe-deadline",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="Heartbeat staleness deadline for the routability probe.",
+    )
+    fleet.add_argument(
+        "--poll",
+        type=float,
+        default=0.25,
+        metavar="SECONDS",
+        help="Fleet monitor poll cadence (deaths, probes, respawns).",
+    )
+    fleet.add_argument(
+        "--spawn-timeout",
+        type=float,
+        default=300.0,
+        metavar="SECONDS",
+        help="Budget for a replica to warm + report ready.",
+    )
+    fleet.add_argument(
+        "--settle",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="Post-storm wait for pending respawn/readmit chains to "
+        "land on fleet.jsonl.",
+    )
+    fleet.add_argument("--max-restarts", type=int, default=8)
+    fleet.add_argument("--circuit-breaker", type=int, default=3)
+    fleet.add_argument(
+        "--backoff-base",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help="Replica restart backoff base (RecoveryPolicy).",
+    )
+    fleet.add_argument(
+        "--backoff-max", type=float, default=300.0, metavar="SECONDS"
+    )
+    fleet.add_argument(
+        "--quarantine-after",
+        type=int,
+        default=2,
+        metavar="N",
+        help="Wedges on the serve family before the replica respawns "
+        "onto a halved bucket (SERVE_SLOTS__scale).",
+    )
+    fleet.add_argument("--tick-every", type=int, default=8)
+    fleet.add_argument(
+        "--replica-health-interval", type=float, default=1.0
+    )
+    fleet.add_argument(
+        "--replica-dispatch-min-deadline", type=float, default=60.0
+    )
+    fleet.add_argument(
+        "--replica-dispatch-first-deadline", type=float, default=900.0
+    )
+    fleet.add_argument(
+        "--replica-watchdog-poll", type=float, default=5.0
+    )
+    fleet.add_argument(
+        "--chaos-kill-after",
+        type=int,
+        default=0,
+        metavar="N",
+        help="SIGKILL one replica after N completed requests "
+        "(the fleet smoke's deterministic chaos trigger; 0 = off).",
+    )
+    fleet.add_argument(
+        "--reload-after",
+        type=int,
+        default=0,
+        metavar="N",
+        help="Start a rolling weight swap after N completed requests "
+        "(0 = off).",
+    )
+    fleet.add_argument(
+        "--smoke",
+        action="store_true",
+        help="Gate on the zero-lost-requests invariant "
+        "(make fleet-smoke drives this on CPU).",
     )
 
     league = sub.add_parser(
@@ -2992,6 +3355,7 @@ def main(argv: list[str] | None = None) -> int:
         "warm": cmd_warm,
         "fit": cmd_fit,
         "serve": cmd_serve,
+        "fleet": cmd_fleet,
         "league": cmd_league,
         "mem": cmd_mem,
         "lint": cmd_lint,

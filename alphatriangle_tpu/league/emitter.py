@@ -18,6 +18,7 @@ staleness metrics, telemetry — works unchanged on served data.
 """
 
 import logging
+import threading
 
 import numpy as np
 
@@ -56,6 +57,11 @@ class TrajectoryEmitter:
         self._done: list[SelfPlayResult] = []
         self.moves_emitted = 0
         self.episodes_emitted = 0
+        # Guards the finished-episode seam: the service thread appends
+        # in on_session_close while the learner thread swaps the list
+        # in drain(); an unguarded append between drain's read and
+        # reset silently lost that episode.
+        self._lock = threading.Lock()
 
     # --- service hooks ----------------------------------------------------
 
@@ -123,19 +129,23 @@ class TrajectoryEmitter:
                 "row_versions": list(rows["version"]),
             },
         )
-        self.episodes_emitted += 1
-        self.moves_emitted += result.num_experiences
+        with self._lock:
+            self.episodes_emitted += 1
+            self.moves_emitted += result.num_experiences
+            if self.sink is None:
+                self._done.append(result)
         if self.sink is not None:
             self.sink(result)
-        else:
-            self._done.append(result)
 
     # --- harvest ----------------------------------------------------------
 
     def drain(self) -> "SelfPlayResult | None":
         """All finished episodes since the last drain, merged into one
-        dense harvest (None when nothing finished)."""
-        results, self._done = self._done, []
+        dense harvest (None when nothing finished). Safe against a
+        concurrent `on_session_close` (the swap happens under the
+        emitter lock; the merge itself runs outside it)."""
+        with self._lock:
+            results, self._done = self._done, []
         return merge_results(results)
 
 

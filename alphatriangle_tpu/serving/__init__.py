@@ -7,21 +7,41 @@ request queue + micro-batch dispatcher with per-request latency SLOs
 (`loadgen.run_simulated_load`). `cli serve` is the front end;
 `arena.play` / `cli eval` / `benchmarks/elo_ladder.py` are the first
 in-repo clients of the same session API.
+
+The fleet layer (docs/SERVING.md "Fleet") splits the package in two:
+`replica.py` hosts one PolicyService per subprocess (imports JAX),
+while `router.py` + `fleet.py` are the JAX-FREE control plane the
+`cli fleet` parent runs — the same contract as the training
+supervisor (supervise/, "the parent must survive anything the device
+runtime does"). Exports are therefore lazy (PEP 562): importing
+`alphatriangle_tpu.serving.fleet` must not drag `service` -> mcts ->
+jax into the parent process.
 """
 
-from .loadgen import run_simulated_load
-from .service import (
-    PolicyService,
-    build_serve_telemetry,
-    serve_program_name,
-)
-from .session import Session, SessionSlots
+_LAZY = {
+    "PolicyService": ".service",
+    "build_serve_telemetry": ".service",
+    "serve_program_name": ".service",
+    "Session": ".session",
+    "SessionSlots": ".session",
+    "run_simulated_load": ".loadgen",
+    "ReplicaRouter": ".router",
+    "RouteResult": ".router",
+    "FleetSupervisor": ".fleet",
+    "run_fleet_load": ".fleet",
+}
 
-__all__ = [
-    "PolicyService",
-    "Session",
-    "SessionSlots",
-    "build_serve_telemetry",
-    "run_simulated_load",
-    "serve_program_name",
-]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    return getattr(import_module(target, __name__), name)
+
+
+def __dir__() -> list:
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,744 @@
+"""Serve-fleet control plane: N replicas, one router, self-healing.
+
+The JAX-FREE parent process behind `cli fleet` (docs/SERVING.md
+"Fleet"): spawns N `serving/replica.py` subprocesses (each hosting one
+PolicyService with its own compiled `serve/b<B>`, heartbeat, flight
+ring and metrics ledger), keeps a `ReplicaRouter` admission view fresh
+via the shared `telemetry.health.probe_run` probe, and reuses PR 14's
+supervision machinery verbatim for replica lifecycle:
+
+- a death is classified with `supervise.supervisor.diagnose` over the
+  replica's OWN run dir, evidence since spawn (a SIGKILL reads clean,
+  a hang-serve wedge reads dispatch-hung naming `serve/b<B>`);
+- `supervise.policy.RecoveryPolicy` maps verdicts to backoff restarts
+  under a restart budget — the serve quarantine arm's
+  `SERVE_SLOTS__scale` override is interpreted HERE, respawning the
+  replica onto a smaller compiled bucket (the degraded fallback);
+- the replica's served-move count is the progress signal that resets
+  the backoff streak (forward motion = traffic served since last
+  death, the serving analogue of a new committed checkpoint).
+
+Every lifecycle and routing decision lands crash-safe in
+`fleet.jsonl` through the same append-only MetricsLedger writer the
+supervisor and training ledgers use — the death -> verdict -> respawn
+-> re-admission chain `make fleet-smoke` asserts is read back from
+this file. The parent also writes plain `kind:"util"` ticks to its
+own metrics.jsonl so `cli perf` / `cli compare` summarize a fleet run
+like any other.
+
+JAX never loads here: replica handles speak JSON lines over pipes,
+and the probe/doctor/policy/ledger stack is stdlib-only (the same
+contract `benchmarks/fleet_smoke.py` pins with an import guard).
+"""
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+from ..supervise.policy import RecoveryPolicy
+from ..supervise.supervisor import diagnose
+from ..telemetry.flight import FlightRecorder
+from ..telemetry.health import PROBE_LIVE, probe_run
+from ..telemetry.ledger import MetricsLedger
+from .router import ReplicaError, ReplicaRouter
+
+logger = logging.getLogger(__name__)
+
+FLEET_FILENAME = "fleet.jsonl"
+
+
+class _Pending:
+    """Minimal future for one in-flight replica request."""
+
+    __slots__ = ("rid", "_handle", "_ev", "value", "error", "cancelled")
+
+    def __init__(self, rid: int, handle=None):
+        self.rid = rid
+        self._handle = handle
+        self._ev = threading.Event()
+        self.value: "dict | None" = None
+        self.error: "Exception | None" = None
+        self.cancelled = False
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: "float | None" = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def resolve(self, value: dict) -> None:
+        self.value = value
+        self._ev.set()
+
+    def fail(self, error: Exception) -> None:
+        if not self._ev.is_set():
+            self.error = error
+            self._ev.set()
+
+    def cancel(self) -> None:
+        """Cancel-on-first-win: drop the request from its handle's
+        queue-depth accounting and resolve the waiter; the replica may
+        still answer (idempotent episodes), the reply is ignored."""
+        self.cancelled = True
+        if self._handle is not None:
+            self._handle._discard(self.rid)
+        self.fail(ReplicaError("cancelled"))
+
+
+class ProcessReplicaHandle:
+    """Persistent identity for one replica slot across incarnations.
+
+    Satisfies the router's handle protocol (`name`/`routable`/
+    `queue_depth`/`bucket`/`submit`). `attach` binds a fresh
+    subprocess (spawn or respawn); a reader thread resolves pending
+    futures from stdout and fails them all on EOF so a SIGKILLed
+    replica turns into immediate retries instead of timeouts."""
+
+    def __init__(self, name: str, run_dir: Path):
+        self.name = name
+        self.run_dir = Path(run_dir)
+        self.proc = None
+        self.generation = 0
+        self.bucket: "int | None" = None
+        self.admit = True  # rolling-reload drain gate
+        self.probe_ok = False
+        self.ready = threading.Event()
+        self.ready_info: "dict | None" = None
+        self.served_moves = 0  # progress signal for the recovery policy
+        self.episodes_ok = 0
+        self._lock = threading.Lock()
+        self._pending: dict[int, _Pending] = {}
+        self._rid = 0
+
+    # --- router protocol -------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def routable(self) -> bool:
+        return (
+            self.alive and self.admit and self.probe_ok and self.ready.is_set()
+        )
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def submit(self, payload: dict) -> _Pending:
+        with self._lock:
+            proc = self.proc
+            if proc is None or proc.poll() is not None:
+                raise ReplicaError(f"replica {self.name} is not running")
+            self._rid += 1
+            pending = _Pending(self._rid, self)
+            self._pending[self._rid] = pending
+            line = json.dumps({**payload, "id": self._rid}) + "\n"
+            try:
+                proc.stdin.write(line)
+                proc.stdin.flush()
+            except Exception as exc:
+                del self._pending[self._rid]
+                raise ReplicaError(
+                    f"replica {self.name} pipe write failed: {exc}"
+                ) from exc
+        return pending
+
+    def request(self, payload: dict, timeout_s: float = 30.0) -> dict:
+        """Synchronous control-plane request (ping/stats/reload)."""
+        pending = self.submit(payload)
+        if not pending.wait(timeout_s):
+            pending.cancel()
+            raise ReplicaError(
+                f"replica {self.name} {payload.get('kind')} timed out "
+                f"after {timeout_s:g}s"
+            )
+        if pending.error is not None:
+            raise pending.error
+        return pending.value or {}
+
+    # --- incarnation lifecycle -------------------------------------------
+
+    def attach(self, proc, bucket: int) -> None:
+        self.proc = proc
+        self.bucket = bucket
+        self.generation += 1
+        self.ready.clear()
+        self.ready_info = None
+        self.probe_ok = False
+        reader = threading.Thread(
+            target=self._read_loop,
+            args=(proc,),
+            name=f"fleet-read-{self.name}",
+            daemon=True,
+        )
+        reader.start()
+
+    def _read_loop(self, proc) -> None:
+        try:
+            for line in proc.stdout:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning(
+                        "%s: unparseable reply line %r", self.name, line[:200]
+                    )
+                    continue
+                if msg.get("kind") == "ready" and "id" not in msg:
+                    self.ready_info = msg
+                    self.ready.set()
+                    continue
+                with self._lock:
+                    pending = self._pending.pop(msg.get("id"), None)
+                if pending is None:
+                    continue  # cancelled (hedge loser) or stale
+                if msg.get("ok"):
+                    if msg.get("kind") == "episode":
+                        self.served_moves += int(msg.get("moves") or 0)
+                        self.episodes_ok += 1
+                    pending.resolve(msg)
+                else:
+                    pending.fail(
+                        ReplicaError(
+                            f"{self.name}: {msg.get('error') or 'replica error'}"
+                        )
+                    )
+        except Exception:
+            logger.exception("%s reader failed", self.name)
+        finally:
+            # EOF: only fail pendings if this is still the live
+            # incarnation (a respawn may already have replaced us).
+            if self.proc is proc:
+                self.fail_all(ReplicaError(f"replica {self.name} died"))
+
+    def fail_all(self, error: Exception) -> None:
+        with self._lock:
+            pending, self._pending = dict(self._pending), {}
+        for p in pending.values():
+            p.fail(error)
+
+    def _discard(self, rid: int) -> None:
+        with self._lock:
+            self._pending.pop(rid, None)
+
+
+class FleetSupervisor:
+    """Spawn/probe/classify/respawn loop around N serve replicas.
+
+    `popen`/`now`/`sleep` are injectable (tests/test_supervise.py
+    style); `policy_factory` builds one RecoveryPolicy PER replica so
+    each has its own backoff streak and restart budget."""
+
+    def __init__(
+        self,
+        run_dir: "Path | str",
+        *,
+        replicas: int = 2,
+        slots: int = 8,
+        sims: int = 4,
+        seed: int = 0,
+        configs_dir: "Path | str | None" = None,
+        replica_extra_argv: "list | None" = None,
+        policy_factory=None,
+        probe_deadline_s: float = 10.0,
+        poll_s: float = 0.25,
+        spawn_timeout_s: float = 300.0,
+        popen=subprocess.Popen,
+        now=time.time,
+        sleep=time.sleep,
+    ) -> None:
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.slots = slots
+        self.sims = sims
+        self.seed = seed
+        self.configs_dir = str(configs_dir) if configs_dir else ""
+        self.replica_extra_argv = list(replica_extra_argv or [])
+        self.probe_deadline_s = probe_deadline_s
+        self.poll_s = poll_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self._popen = popen
+        self._now = now
+        self._sleep = sleep
+        policy_factory = policy_factory or RecoveryPolicy
+        self._ledger = MetricsLedger(self.run_dir / FLEET_FILENAME)
+        self._metrics = MetricsLedger(self.run_dir / "metrics.jsonl")
+        # The fleet's own flight ring: routed requests bracket as
+        # `fleet/route` so a dead parent names its in-flight requests.
+        self.flight = FlightRecorder(self.run_dir / "flight.jsonl")
+        self.handles = [
+            ProcessReplicaHandle(f"r{i}", self.run_dir / f"replica_r{i}")
+            for i in range(replicas)
+        ]
+        self._policies = {h.name: policy_factory() for h in self.handles}
+        self._spawn_t: dict[str, float] = {}
+        self._attempts: dict[str, int] = {h.name: 0 for h in self.handles}
+        self._overrides: dict[str, dict] = {h.name: {} for h in self.handles}
+        self._restart_at: dict[str, float] = {}
+        self.gaveup: set = set()
+        self.deaths = 0
+        self.respawns = 0
+        self.evictions = 0
+        self.readmissions = 0
+        self.reload_rounds = 0
+        self.reload_recompiles = 0
+        self._stop = threading.Event()
+        self._monitor: "threading.Thread | None" = None
+
+    # --- ledger -----------------------------------------------------------
+
+    def _event(self, event: str, **fields) -> None:
+        self._ledger.append(
+            {
+                "kind": "fleet",
+                "event": event,
+                "time": self._now(),
+                "pid": os.getpid(),
+                **fields,
+            }
+        )
+
+    def util_tick(
+        self, step: int, moves: int, requests: int, window_s: float
+    ) -> None:
+        """One `kind:"util"` record on the fleet parent's metrics
+        ledger — the minimal utilization signature `cli perf` /
+        `load_comparable` need to treat a fleet run like any run."""
+        dt = max(1e-9, window_s)
+        self._metrics.append(
+            {
+                "kind": "util",
+                "time": self._now(),
+                "step": step,
+                "window_s": round(window_s, 3),
+                "moves_per_sec": round(moves / dt, 3),
+                "serve_requests_per_sec": round(requests / dt, 3),
+            }
+        )
+
+    def router_event(self, fields: dict) -> None:
+        """ReplicaRouter.on_event sink: shed/retry/hedge/exhausted
+        decisions land beside the lifecycle events."""
+        fields = dict(fields)
+        # The router annotates sheds with the REQUEST's kind
+        # ("episode"); rename it or it would override the ledger's
+        # `kind: "fleet"` and hide the event from summarize_fleet.
+        if "kind" in fields:
+            fields["request_kind"] = fields.pop("kind")
+        self._event(fields.pop("event", "route"), **fields)
+
+    def build_router(self, **router_kw) -> ReplicaRouter:
+        router_kw.setdefault("flight", self.flight)
+        router_kw.setdefault("on_event", self.router_event)
+        return ReplicaRouter(self.handles, **router_kw)
+
+    # --- spawning ---------------------------------------------------------
+
+    def _effective_slots(self, name: str) -> int:
+        scale = float(
+            self._overrides.get(name, {}).get("SERVE_SLOTS__scale", 1.0)
+        )
+        return max(1, int(round(self.slots * scale)))
+
+    def _spawn(self, handle: ProcessReplicaHandle, event: str) -> None:
+        self._attempts[handle.name] += 1
+        attempt = self._attempts[handle.name]
+        bucket = self._effective_slots(handle.name)
+        handle.run_dir.mkdir(parents=True, exist_ok=True)
+        argv = [
+            sys.executable,
+            "-m",
+            "alphatriangle_tpu.serving.replica",
+            "--run-dir",
+            str(handle.run_dir),
+            "--configs-dir",
+            self.configs_dir,
+            "--name",
+            handle.name,
+            "--slots",
+            str(bucket),
+            "--sims",
+            str(self.sims),
+            "--seed",
+            str(self.seed + int(handle.name[1:] or 0)),
+            *self.replica_extra_argv,
+        ]
+        stderr_log = open(  # noqa: SIM115 — lives as long as the child
+            handle.run_dir / "replica.stderr.log", "ab"
+        )
+        proc = self._popen(
+            argv,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=stderr_log,
+            text=True,
+        )
+        stderr_log.close()
+        self._spawn_t[handle.name] = self._now()
+        handle.attach(proc, bucket)
+        self._event(
+            event,
+            replica=handle.name,
+            pid=proc.pid,
+            slots=bucket,
+            attempt=attempt,
+            overrides=self._overrides.get(handle.name) or {},
+        )
+
+    def start(self, wait_ready: bool = True) -> None:
+        self._event(
+            "fleet-start",
+            replicas=len(self.handles),
+            slots=self.slots,
+            sims=self.sims,
+        )
+        for h in self.handles:
+            self._spawn(h, "spawn")
+        if wait_ready:
+            deadline = time.monotonic() + self.spawn_timeout_s
+            for h in self.handles:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not h.ready.wait(remaining):
+                    raise RuntimeError(
+                        f"replica {h.name} not ready within "
+                        f"{self.spawn_timeout_s:g}s (see "
+                        f"{h.run_dir / 'replica.stderr.log'})"
+                    )
+            for h in self.handles:
+                self._probe(h)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    # --- monitoring -------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("fleet monitor iteration failed")
+
+    def poll_once(self) -> None:
+        now = self._now()
+        for h in self.handles:
+            if h.name in self.gaveup:
+                continue
+            if h.name in self._restart_at:
+                if now >= self._restart_at[h.name]:
+                    del self._restart_at[h.name]
+                    self.respawns += 1
+                    self._spawn(h, "respawn")
+                continue
+            if h.proc is not None and h.proc.poll() is not None:
+                self._on_death(h)
+                continue
+            if h.alive and h.ready.is_set():
+                self._probe(h)
+
+    def _on_death(self, handle: ProcessReplicaHandle) -> None:
+        rc = handle.proc.returncode
+        handle.fail_all(
+            ReplicaError(f"replica {handle.name} died (rc={rc})")
+        )
+        handle.probe_ok = False
+        verdict = diagnose(
+            handle.run_dir, since=self._spawn_t.get(handle.name, 0.0)
+        )
+        policy = self._policies[handle.name]
+        action = policy.decide(
+            verdict=verdict["verdict"],
+            exit_code=rc if rc is not None else -1,
+            family=verdict.get("family"),
+            progress_step=handle.served_moves,
+        )
+        self.deaths += 1
+        self._event(
+            "death",
+            replica=handle.name,
+            rc=rc,
+            generation=handle.generation,
+            verdict=verdict["verdict"],
+            program=verdict.get("program"),
+            family=verdict.get("family"),
+            progress_moves=handle.served_moves,
+            action=action.kind,
+            delay_s=action.delay_s,
+            overrides=action.overrides,
+            reason=action.reason,
+        )
+        logger.warning(
+            "replica %s died (rc=%s, verdict=%s) -> %s: %s",
+            handle.name,
+            rc,
+            verdict["verdict"],
+            action.kind,
+            action.reason,
+        )
+        if action.kind != "restart":
+            self.gaveup.add(handle.name)
+            self._event("give-up", replica=handle.name, reason=action.reason)
+            return
+        self._overrides[handle.name] = dict(action.overrides)
+        self._restart_at[handle.name] = self._now() + action.delay_s
+
+    def _probe(self, handle: ProcessReplicaHandle) -> None:
+        result = probe_run(
+            handle.run_dir,
+            now=self._now(),
+            deadline_s=self.probe_deadline_s,
+        )
+        ok = result["code"] == PROBE_LIVE
+        if ok and not handle.probe_ok:
+            handle.probe_ok = True
+            self.readmissions += 1
+            self._event(
+                "readmit",
+                replica=handle.name,
+                generation=handle.generation,
+                slots=handle.bucket,
+            )
+        elif not ok and handle.probe_ok:
+            handle.probe_ok = False
+            self.evictions += 1
+            self._event(
+                "evict",
+                replica=handle.name,
+                code=result["code"],
+                verdict=result["verdict"],
+                reason=result["reason"],
+            )
+
+    # --- rolling weight swap ---------------------------------------------
+
+    def rolling_reload(
+        self,
+        drain_timeout_s: float = 30.0,
+        request_timeout_s: float = 120.0,
+    ) -> dict:
+        """Drain one replica at a time out of admission, hot-reload its
+        weights, verify zero recompiles from the reply, re-admit. The
+        rest of the fleet keeps serving throughout."""
+        self._event("reload-start")
+        reloaded, recompiles = 0, 0
+        for h in self.handles:
+            if not (h.alive and h.ready.is_set()):
+                continue
+            h.admit = False
+            t0 = time.monotonic()
+            while h.queue_depth > 0 and time.monotonic() - t0 < drain_timeout_s:
+                self._sleep(0.05)
+            try:
+                reply = h.request(
+                    {"kind": "reload"}, timeout_s=request_timeout_s
+                )
+                rec = int(reply.get("recompiles") or 0)
+                reloaded += 1
+                recompiles += rec
+                self._event(
+                    "replica-reloaded",
+                    replica=h.name,
+                    reloads=reply.get("reloads"),
+                    recompiles=rec,
+                    drained_s=round(time.monotonic() - t0, 3),
+                )
+            except Exception as exc:
+                self._event(
+                    "reload-failed", replica=h.name, error=str(exc)
+                )
+            finally:
+                h.admit = True
+        self.reload_rounds += 1
+        self.reload_recompiles += recompiles
+        self._event("reload-done", replicas=reloaded, recompiles=recompiles)
+        return {"replicas": reloaded, "recompiles": recompiles}
+
+    # --- chaos + shutdown --------------------------------------------------
+
+    def kill_replica(self, name: "str | None" = None) -> "str | None":
+        """SIGKILL one live replica (the storm's chaos hook). Returns
+        the victim's name (None when nothing is killable)."""
+        for h in self.handles:
+            if (name is None or h.name == name) and h.alive:
+                self._event("chaos-kill", replica=h.name, pid=h.proc.pid)
+                try:
+                    os.kill(h.proc.pid, signal.SIGKILL)
+                except OSError:
+                    return None
+                return h.name
+        return None
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        for h in self.handles:
+            if not h.alive:
+                continue
+            try:
+                h.request({"kind": "shutdown"}, timeout_s=timeout_s)
+            except Exception:
+                pass
+            try:
+                h.proc.stdin.close()
+            except Exception:
+                pass
+            try:
+                h.proc.wait(timeout=timeout_s)
+            except Exception:
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=5.0)
+                except Exception:
+                    pass
+        self.flight.close()
+        self._event(
+            "fleet-stop",
+            deaths=self.deaths,
+            respawns=self.respawns,
+            gaveup=sorted(self.gaveup),
+        )
+
+    def summary(self) -> dict:
+        return {
+            "replicas": len(self.handles),
+            "deaths": self.deaths,
+            "respawns": self.respawns,
+            "evictions": self.evictions,
+            "readmissions": self.readmissions,
+            "gaveup": sorted(self.gaveup),
+            "reload_rounds": self.reload_rounds,
+            "reload_recompiles": self.reload_recompiles,
+            "buckets": {h.name: h.bucket for h in self.handles},
+        }
+
+
+def run_fleet_load(
+    router: ReplicaRouter,
+    fleet: "FleetSupervisor | None" = None,
+    *,
+    requests: int = 32,
+    concurrency: int = 8,
+    max_moves: int = 12,
+    seed: int = 0,
+    timeout_s: "float | None" = None,
+    tick_every_s: float = 1.0,
+    on_complete=None,
+) -> dict:
+    """The loadgen storm: `requests` episode requests pushed through
+    the router from `concurrency` worker threads. `on_complete(n)`
+    fires after the n-th terminal outcome (the smoke's chaos-kill and
+    rolling-reload triggers). Returns the accounting the zero-lost
+    invariant is asserted on."""
+    from ..telemetry.perf import _percentile
+
+    jobs: list[int] = list(range(requests))
+    jobs.reverse()
+    results: list = []
+    lock = threading.Lock()
+    moves_window = [0]
+    t_start = time.monotonic()
+    last_tick = [t_start]
+
+    def worker() -> None:
+        while True:
+            with lock:
+                if not jobs:
+                    return
+                i = jobs.pop()
+            res = router.route(
+                {"kind": "episode", "seed": seed + i, "max_moves": max_moves},
+                timeout_s=timeout_s,
+            )
+            with lock:
+                results.append(res)
+                n = len(results)
+                if res.ok and res.value:
+                    moves_window[0] += int(res.value.get("moves") or 0)
+                now = time.monotonic()
+                tick_due = (
+                    fleet is not None
+                    and now - last_tick[0] >= tick_every_s
+                )
+                if tick_due:
+                    window = now - last_tick[0]
+                    moves, moves_window[0] = moves_window[0], 0
+                    last_tick[0] = now
+            if tick_due:
+                fleet.util_tick(
+                    step=n, moves=moves, requests=n, window_s=window
+                )
+            if on_complete is not None:
+                try:
+                    on_complete(n)
+                except Exception:
+                    logger.exception("storm on_complete hook failed")
+
+    threads = [
+        threading.Thread(target=worker, name=f"storm-{i}", daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = max(1e-9, time.monotonic() - t_start)
+    if fleet is not None:
+        fleet.util_tick(
+            step=len(results),
+            moves=sum(
+                int(r.value.get("moves") or 0)
+                for r in results
+                if r.ok and r.value
+            ),
+            requests=len(results),
+            window_s=elapsed,
+        )
+
+    completed = [r for r in results if r.ok]
+    shed = [r for r in results if not r.ok and r.rejection is not None]
+    lost = len(results) - len(completed) - len(shed)
+    lat_ms = [
+        float(v)
+        for r in completed
+        if r.value
+        for v in (r.value.get("lat_ms") or [])
+    ]
+    request_s = [r.wait_s for r in completed]
+    summary = {
+        "requests": requests,
+        "terminal": len(results),
+        "completed": len(completed),
+        "shed": len(shed),
+        "shed_by_code": {
+            code: sum(1 for r in shed if r.rejection == code)
+            for code in sorted({r.rejection for r in shed})
+        },
+        "lost": lost,
+        "retried_requests": sum(1 for r in results if r.attempts > 1),
+        "hedged_requests": sum(1 for r in results if r.hedged),
+        "moves": sum(
+            int(r.value.get("moves") or 0)
+            for r in completed
+            if r.value
+        ),
+        "elapsed_s": round(elapsed, 3),
+        "requests_per_sec": round(len(completed) / elapsed, 3),
+        "move_latency_ms_p50": _percentile(lat_ms, 0.50),
+        "move_latency_ms_p95": _percentile(lat_ms, 0.95),
+        "request_s_p95": _percentile(request_s, 0.95),
+        "router": router.stats.as_dict(),
+    }
+    if fleet is not None:
+        fleet._event("storm-summary", **summary)
+    return summary

@@ -1,0 +1,403 @@
+"""One serve-fleet replica: a PolicyService behind a pipe protocol.
+
+The JAX side of the fleet split (docs/SERVING.md "Fleet"): each
+replica is a subprocess hosting one `PolicyService` (its own compiled
+`serve/b<B>` program, its own run dir with heartbeat + flight ring +
+metrics ledger), spoken to over a JSON-lines stdin/stdout protocol by
+the JAX-free fleet parent (`serving/fleet.py`). On a TPU pod this
+becomes one replica per device slice; on CPU tier-1 it is N processes
+— the process boundary is the point: a wedged or SIGKILLed replica
+takes down exactly one compiled program, and the router re-routes.
+
+Protocol (one JSON object per line, `id` echoes back):
+
+    {"id": N, "kind": "episode", "seed": S, "max_moves": M}
+        -> {"id": N, "ok": true, "moves": m, "done": d, "score": s,
+            "lat_ms": [per-move latency]}
+        Plays one full game through the service (idempotent given the
+        seed — safe to retry/hedge on another replica).
+    {"id": N, "kind": "ping"}     -> liveness + queue depth
+    {"id": N, "kind": "stats"}    -> serve_stats + compile-cache stats
+    {"id": N, "kind": "reload"}   -> hot weight reload; the reply's
+        `cache_misses` lets the fleet assert zero recompiles
+    {"id": N, "kind": "shutdown"} -> ack, then clean exit
+
+Threads: the main thread reads stdin and answers control requests
+(responsive even when dispatch is busy); a dispatcher thread batches
+every active episode's pending move into one `dispatch()` wave (the
+micro-batching contract); a heartbeat thread keeps `health.json`
+fresh while idle so the parent's probe gates admission on liveness,
+not traffic. A `hang-serve` fault wedges the dispatcher inside its
+flight bracket — the in-process DispatchWatchdog exits 113 and the
+unsealed `serve/b<B>` intent is the evidence `cli doctor` and the
+fleet probe both read.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+READY_KIND = "ready"
+
+
+class _Episode:
+    __slots__ = ("req_id", "sid", "seed", "max_moves", "moves", "lat_ms")
+
+    def __init__(self, req_id, sid, seed, max_moves):
+        self.req_id = req_id
+        self.sid = sid
+        self.seed = seed
+        self.max_moves = max_moves
+        self.moves = 0
+        self.lat_ms: list = []
+
+
+class ReplicaServer:
+    """Protocol loop around one PolicyService (built by `main`)."""
+
+    def __init__(self, service, telemetry, tick_every: int = 8, out=None):
+        self.service = service
+        self.telemetry = telemetry
+        self.tick_every = tick_every
+        self.out = out or sys.stdout
+        self._out_lock = threading.Lock()
+        self._active: dict[int, _Episode] = {}  # sid -> episode
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._dispatches_since_tick = 0
+
+    # --- wire -----------------------------------------------------------
+
+    def reply(self, payload: dict) -> None:
+        with self._out_lock:
+            self.out.write(json.dumps(payload) + "\n")
+            self.out.flush()
+
+    # --- dispatcher thread ----------------------------------------------
+
+    def _finish(self, ep: _Episode, ok: bool, error: str | None = None):
+        try:
+            summary = self.service.close_session(ep.sid)
+        except Exception:
+            summary = {}
+        done = bool(summary.get("done"))
+        self.reply(
+            {
+                "id": ep.req_id,
+                "ok": ok,
+                "kind": "episode",
+                "seed": ep.seed,
+                "moves": ep.moves,
+                "done": done,
+                "score": summary.get("score"),
+                "lat_ms": [round(v, 3) for v in ep.lat_ms],
+                **({"error": error} if error else {}),
+            }
+        )
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                while not self._active and not self._stop.is_set():
+                    self._cond.wait(timeout=0.2)
+                if self._stop.is_set():
+                    return
+            try:
+                results = self.service.dispatch()
+            except Exception as exc:
+                # A dispatch that raises (e.g. the crash-serve fault)
+                # sealed its flight bracket ok:false; the sessions it
+                # was serving are in an undefined mid-wave state, so
+                # fail them back to the router (which retries them on
+                # another replica) and keep serving.
+                logger.exception("dispatch failed; failing active episodes")
+                with self._cond:
+                    failed, self._active = dict(self._active), {}
+                for ep in failed.values():
+                    self._finish(ep, ok=False, error=f"dispatch: {exc}")
+                continue
+            finished: list = []
+            with self._cond:
+                for r in results:
+                    ep = self._active.get(r["sid"])
+                    if ep is None:
+                        continue
+                    ep.moves += 1
+                    ep.lat_ms.append(float(r["latency_ms"]))
+                    if r["done"] or ep.moves >= ep.max_moves:
+                        finished.append(ep)
+                        del self._active[ep.sid]
+                    else:
+                        self.service.request_move(ep.sid)
+            for ep in finished:
+                self._finish(ep, ok=True)
+            if results:
+                self._dispatches_since_tick += 1
+                if self._dispatches_since_tick >= self.tick_every:
+                    self._dispatches_since_tick = 0
+                    try:
+                        self.service.tick()
+                    except Exception:
+                        logger.exception("serve tick failed (continuing)")
+
+    # --- heartbeat thread -----------------------------------------------
+
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.telemetry.health.write()
+            except Exception:
+                logger.exception("heartbeat write failed (continuing)")
+
+    # --- control-plane handlers ------------------------------------------
+
+    def _handle(self, req: dict) -> bool:
+        """Process one request; returns False on shutdown."""
+        kind = req.get("kind")
+        rid = req.get("id")
+        if kind == "episode":
+            try:
+                s = self.service.open_session(seed=int(req.get("seed", 0)))
+            except Exception as exc:
+                self.reply(
+                    {"id": rid, "ok": False, "kind": kind, "error": str(exc)}
+                )
+                return True
+            # Register BEFORE request_move: the dispatcher may serve
+            # the very next wave, and a result for an unregistered sid
+            # would be dropped (wedging the episode forever).
+            with self._cond:
+                self._active[s.sid] = _Episode(
+                    rid, s.sid, req.get("seed"), int(req.get("max_moves", 64))
+                )
+            try:
+                self.service.request_move(s.sid)
+            except Exception as exc:
+                with self._cond:
+                    self._active.pop(s.sid, None)
+                try:
+                    self.service.close_session(s.sid)
+                except Exception:
+                    pass
+                self.reply(
+                    {"id": rid, "ok": False, "kind": kind, "error": str(exc)}
+                )
+                return True
+            with self._cond:
+                self._cond.notify()
+            return True
+        if kind == "ping":
+            self.reply(
+                {
+                    "id": rid,
+                    "ok": True,
+                    "kind": kind,
+                    "pid": os.getpid(),
+                    "queue_depth": self.service.queue_depth,
+                    "dispatches": self.service.dispatch_count,
+                }
+            )
+            return True
+        if kind == "stats":
+            from ..compile_cache import get_compile_cache
+
+            cache = get_compile_cache().stats()
+            self.reply(
+                {
+                    "id": rid,
+                    "ok": True,
+                    "kind": kind,
+                    "cache_misses": cache.get("misses"),
+                    "cache_events": len(cache.get("events") or []),
+                    **self.service.serve_stats(drain=False),
+                }
+            )
+            return True
+        if kind == "reload":
+            from ..compile_cache import get_compile_cache
+
+            before = get_compile_cache().stats().get("misses")
+            reloads = self.service.reload_weights()
+            after = get_compile_cache().stats().get("misses")
+            self.reply(
+                {
+                    "id": rid,
+                    "ok": True,
+                    "kind": kind,
+                    "reloads": reloads,
+                    "cache_misses": after,
+                    "recompiles": (after or 0) - (before or 0),
+                }
+            )
+            return True
+        if kind == "shutdown":
+            self.reply({"id": rid, "ok": True, "kind": kind})
+            return False
+        self.reply(
+            {"id": rid, "ok": False, "error": f"unknown kind {kind!r}"}
+        )
+        return True
+
+    # --- lifecycle --------------------------------------------------------
+
+    def serve_forever(self, heartbeat_s: float, stdin=None) -> int:
+        stdin = stdin or sys.stdin
+        threads = [
+            threading.Thread(
+                target=self._dispatch_loop, name="replica-dispatch", daemon=True
+            ),
+            threading.Thread(
+                target=self._heartbeat_loop,
+                args=(heartbeat_s,),
+                name="replica-heartbeat",
+                daemon=True,
+            ),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for line in stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning("unparseable request line: %r", line[:200])
+                    continue
+                try:
+                    if not self._handle(req):
+                        break
+                except Exception as exc:
+                    logger.exception("request handler failed")
+                    self.reply(
+                        {"id": req.get("id"), "ok": False, "error": str(exc)}
+                    )
+        finally:
+            self._stop.set()
+            with self._cond:
+                self._cond.notify_all()
+            for t in threads:
+                t.join(timeout=5.0)
+        return 0
+
+
+def main(argv: "list | None" = None) -> int:
+    p = argparse.ArgumentParser(description="serve-fleet replica worker")
+    p.add_argument("--run-dir", required=True, help="this replica's run dir")
+    p.add_argument(
+        "--configs-dir",
+        default="",
+        help="dir holding configs.json (board/net); flagship defaults "
+        "when missing",
+    )
+    p.add_argument("--name", default="replica")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--sims", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tick-every", type=int, default=8)
+    p.add_argument("--gumbel", action="store_true")
+    p.add_argument("--health-interval", type=float, default=1.0)
+    p.add_argument("--watchdog-deadline", type=float, default=300.0)
+    p.add_argument("--dispatch-min-deadline", type=float, default=60.0)
+    p.add_argument("--dispatch-first-deadline", type=float, default=900.0)
+    p.add_argument("--dispatch-watchdog-poll", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        stream=sys.stderr,
+        format=f"%(asctime)s {args.name} %(levelname)s %(message)s",
+    )
+
+    from pathlib import Path
+
+    from ..config import AlphaTriangleMCTSConfig, TelemetryConfig
+    from ..config.run_configs import load_run_configs_or_default
+    from ..env.engine import TriangleEnv
+    from ..features.core import get_feature_extractor
+    from ..mcts import BatchedMCTS, GumbelMCTS
+    from ..nn.network import NeuralNetwork
+    from .service import PolicyService, build_serve_telemetry
+
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    cfg_dir = Path(args.configs_dir) if args.configs_dir else Path("/nonexistent")
+    env_cfg, model_cfg = load_run_configs_or_default(cfg_dir)
+    mcts_cfg = AlphaTriangleMCTSConfig(max_simulations=args.sims)
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    mcts_cls = GumbelMCTS if args.gumbel else BatchedMCTS
+    mcts_kw = {"exploit": True} if args.gumbel else {}
+    mcts = mcts_cls(
+        env, extractor, net.model, mcts_cfg, net.support, **mcts_kw
+    )
+
+    tele_cfg = TelemetryConfig(
+        HEALTH_WRITE_INTERVAL_S=args.health_interval,
+        WATCHDOG_DEADLINE_S=args.watchdog_deadline,
+        DISPATCH_MIN_DEADLINE_S=args.dispatch_min_deadline,
+        DISPATCH_FIRST_DEADLINE_S=args.dispatch_first_deadline,
+        DISPATCH_WATCHDOG_POLL_S=args.dispatch_watchdog_poll,
+    )
+    telemetry = build_serve_telemetry(
+        run_dir, args.name, env_cfg, model_cfg, telemetry_config=tele_cfg
+    )
+    from ..compile_cache import get_compile_cache
+
+    get_compile_cache().set_tracer(telemetry.tracer)
+    service = PolicyService(
+        env,
+        extractor,
+        net,
+        mcts,
+        slots=args.slots,
+        use_gumbel=args.gumbel,
+        telemetry=telemetry,
+        rng_seed=args.seed,
+    )
+    # AOT warm BEFORE the ready line: episode requests never pay the
+    # search compile, so the storm's move latencies measure serving.
+    t0 = time.time()
+    aot = service.warm()
+    logger.info(
+        "warm %s in %.1fs (slots=%d sims=%d)",
+        "aot" if aot else "jit-fallback",
+        time.time() - t0,
+        args.slots,
+        args.sims,
+    )
+    telemetry.start()
+    # First heartbeat BEFORE the ready line: the fleet parent's probe
+    # gates admission on a fresh health.json, so a just-ready replica
+    # must already have one on disk.
+    telemetry.health.write()
+    server = ReplicaServer(service, telemetry, tick_every=args.tick_every)
+    server.reply(
+        {
+            "kind": READY_KIND,
+            "name": args.name,
+            "pid": os.getpid(),
+            "slots": args.slots,
+            "warm_aot": bool(aot),
+        }
+    )
+    try:
+        return server.serve_forever(heartbeat_s=args.health_interval)
+    finally:
+        try:
+            service.tick()
+        except Exception:
+            pass
+        telemetry.close(step=service.dispatch_count)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,7 @@ Composition of existing training plumbing, per the ROADMAP item:
 """
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -267,6 +268,19 @@ class PolicyService:
                 serve_program_name(self.sessions.slots),
                 avals=f"b{len(served)}",
             ):
+                # Chaos hook (docs/ROBUSTNESS.md): env-gated so an
+                # unarmed service never imports the fault module. Fires
+                # INSIDE the flight bracket — a hang-serve leaves an
+                # unsealed serve/b<B> intent (the probe's evidence), a
+                # crash-serve seals ok:false and surfaces to the caller.
+                if os.environ.get("ALPHATRIANGLE_FAULTS"):
+                    from ..supervise.faults import fault_point
+
+                    fault_point(
+                        "serve-dispatch",
+                        self.dispatch_count,
+                        flight_path=getattr(self.flight, "path", None),
+                    )
                 out = self._search(
                     self._serve_variables(), self.sessions.states, rng
                 )
@@ -348,7 +362,17 @@ class PolicyService:
     def serve_stats(self, drain: bool = True) -> dict:
         """The `serve_*` fields for one utilization tick: current
         occupancy + this window's request percentiles. `drain` resets
-        the window (the tick cadence)."""
+        the window (the tick cadence).
+
+        Snapshot + reset happen under the service lock — dispatch holds
+        the same (reentrant) lock while appending window records, so a
+        drain landing mid-dispatch can no longer read the lists and
+        then reset them around a concurrent append (the lost-request
+        race test_serving pins with a concurrent drainer)."""
+        with self._lock:
+            return self._serve_stats_locked(drain)
+
+    def _serve_stats_locked(self, drain: bool) -> dict:
         now = self._clock()
         dt = max(1e-9, now - self._last_tick_t)
         snap = self.sessions.snapshot()
@@ -359,6 +383,7 @@ class PolicyService:
             "serve_sessions_retired": snap["retired_total"],
             "serve_queue_depth": self.queue_depth,
             "serve_requests_total": self.requests_total,
+            "serve_window_requests": self._win_requests,
             "serve_requests_per_sec": round(self._win_requests / dt, 2),
             "serve_batch_fill": (
                 round(float(np.mean(self._win_fill)), 4)

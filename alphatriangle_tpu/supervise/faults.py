@@ -29,6 +29,12 @@ the production modules, so an unarmed process never touches this file):
     sigkill-save@step=N     persistence.save, after the async Orbax
                             dispatch + meta write but BEFORE the commit
                             marker — the torn-checkpoint drill
+    hang-serve@after=N      serving.PolicyService.dispatch — block the
+                            serve dispatch inside its flight bracket
+                            (unsealed `serve/b<B>` intent; the replica's
+                            watchdog fires 113, the fleet re-routes)
+    crash-serve@after=N     same site — raise RuntimeError inside the
+                            bracket (seals ok:false, replica survives)
 
 JAX-free (stdlib only): imported by telemetry + the supervisor parent.
 """
@@ -50,6 +56,7 @@ SITE_FAULTS = {
     "dispatch": ("hang-dispatch", "corrupt-ring"),
     "step": ("sigterm", "sigkill", "crash"),
     "checkpoint-save": ("sigkill-save",),
+    "serve-dispatch": ("hang-serve", "crash-serve"),
 }
 
 # A hung dispatch must die by watchdog, not hang forever if the
@@ -121,8 +128,12 @@ def fault_point(
         if threshold is None or n < threshold or not _claim(name):
             continue
         logger.error("FAULT %s firing at %s=%d", name, site, n)
-        if name == "hang-dispatch":
+        if name in ("hang-dispatch", "hang-serve"):
             _hang()
+        elif name == "crash-serve":
+            raise RuntimeError(
+                f"injected serve-dispatch crash at dispatch {n}"
+            )
         elif name == "corrupt-ring":
             _corrupt_ring(flight_path)
         elif name == "sigterm":

@@ -51,6 +51,11 @@ QUARANTINE_OVERRIDES: dict[str, dict] = {
     "megastep": {"FUSED_MEGASTEP": False},
     "learner": {"FUSED_LEARNER_STEPS": 1},
     "rollout": {"ASYNC_ROLLOUTS": False},
+    # Serve replicas: halve the compiled serve bucket. Interpreted by
+    # the fleet supervisor (serving/fleet.py maps it onto the replica's
+    # --slots argv), not by TrainConfig — a smaller bucket is the
+    # degraded fallback docs/SERVING.md "Fleet" describes.
+    "serve": {"SERVE_SLOTS__scale": 0.5},
 }
 
 

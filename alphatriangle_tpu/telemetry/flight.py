@@ -95,6 +95,11 @@ def program_family(program: str) -> str:
         return "megastep"
     if head == "serve":
         return "serve"
+    if head == "fleet":
+        # Router dispatch brackets (`fleet/route`, serving/router.py):
+        # host-side fan-out, but bracketed the same way so an unsealed
+        # route names the request the fleet parent died holding.
+        return "fleet"
     return head
 
 

@@ -384,3 +384,101 @@ def health_verdict(
     if payload.get("stalled"):
         return False, age, "watchdog flagged a stall (no training progress)"
     return True, age, "live"
+
+
+# One probe implementation shared by `cli health --probe`, the fleet
+# router's admission gate (serving/fleet.py), and external orchestrators
+# (k8s-style readiness): exit-code contract in docs/OBSERVABILITY.md.
+PROBE_LIVE = 0
+PROBE_UNHEALTHY = 1  # stale heartbeat or watchdog-flagged stall
+PROBE_MISSING = 2  # no readable health.json
+PROBE_DISPATCH_OVERDUE = 3  # unsealed flight intent past its deadline
+
+
+def probe_run(
+    run_dir: Path,
+    now: float | None = None,
+    deadline_s: float | None = None,
+    dispatch_slack_s: float = 2.0,
+) -> dict:
+    """Machine-readable liveness probe for one run dir (no JAX).
+
+    Combines the two independent death signals this repo records:
+    heartbeat freshness (`health.json`, written by RunTelemetry) and
+    the flight ring's unsealed-intent-past-deadline check — a process
+    can heartbeat happily from a side thread while its dispatch thread
+    is wedged inside a device program, and only the flight ring sees
+    that. Returns a one-line-JSON-able payload whose `code` field is
+    the process exit code contract above; `dispatch_slack_s` grace
+    keeps the probe from racing the in-process DispatchWatchdog."""
+    from .flight import FLIGHT_FILENAME, read_flight, unsealed_intents
+
+    run_dir = Path(run_dir)
+    now = time.time() if now is None else now
+    out: dict = {
+        "schema": "alphatriangle.probe.v1",
+        "run_dir": str(run_dir),
+        "time": now,
+    }
+    payload = read_health(run_dir / "health.json")
+    if payload is None:
+        out.update(
+            code=PROBE_MISSING,
+            verdict="missing",
+            reason="no readable health.json",
+            heartbeat_age_s=None,
+        )
+        return out
+    live, age, reason = health_verdict(payload, now=now, deadline_s=deadline_s)
+    out.update(
+        heartbeat_age_s=round(age, 3),
+        pid=payload.get("pid"),
+        stalled=bool(payload.get("stalled")),
+    )
+    overdue = []
+    health_pid = payload.get("pid")
+    for intent in unsealed_intents(read_flight(run_dir / FLIGHT_FILENAME)):
+        intent_deadline = intent.get("deadline_s")
+        intent_t = intent.get("time")
+        if intent_deadline is None or intent_t is None:
+            continue
+        # A dead incarnation's unsealed intent is the doctor's death
+        # evidence, not a verdict on the CURRENT process: without this
+        # pid gate a respawned replica would probe dispatch-overdue
+        # forever on its predecessor's wedge confession.
+        intent_pid = intent.get("pid")
+        if (
+            health_pid is not None
+            and intent_pid is not None
+            and intent_pid != health_pid
+        ):
+            continue
+        intent_age = now - float(intent_t)
+        if intent_age > float(intent_deadline) + dispatch_slack_s:
+            overdue.append(
+                {
+                    "program": intent.get("program"),
+                    "seq": intent.get("seq"),
+                    "age_s": round(intent_age, 3),
+                    "deadline_s": float(intent_deadline),
+                }
+            )
+    out["overdue"] = overdue
+    if overdue:
+        out.update(
+            code=PROBE_DISPATCH_OVERDUE,
+            verdict="dispatch-overdue",
+            reason=(
+                f"unsealed dispatch past deadline: {overdue[0]['program']} "
+                f"({overdue[0]['age_s']:.1f}s > {overdue[0]['deadline_s']:.0f}s)"
+            ),
+        )
+    elif not live:
+        out.update(
+            code=PROBE_UNHEALTHY,
+            verdict="stalled" if payload.get("stalled") else "stale",
+            reason=reason,
+        )
+    else:
+        out.update(code=PROBE_LIVE, verdict="live", reason=reason)
+    return out

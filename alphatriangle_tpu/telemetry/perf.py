@@ -58,6 +58,12 @@ COMPARE_METRICS = (
     # into replay rows. Only flywheel runs carry it (rows compare only
     # when both sides have the metric, like the serve SLOs).
     "league_ingested_moves_per_sec",
+    # Fleet storm SLOs (serving/fleet.py): end-to-end move latency and
+    # served request rate as the ROUTER saw them — retries, hedges and
+    # failovers included, so a fleet that hides replica churn well
+    # compares well. Only fleet runs carry them.
+    "fleet_move_latency_ms_p95",
+    "fleet_requests_per_sec",
 )
 
 # Metrics where a LOWER candidate value is the good direction.
@@ -66,6 +72,7 @@ LOWER_IS_BETTER = frozenset(
         "mem_peak_bytes_in_use",
         "memory_budget_bytes",
         "serve_move_latency_ms_p95",
+        "fleet_move_latency_ms_p95",
     }
 )
 
@@ -477,6 +484,64 @@ def summarize_league(records: list) -> "dict | None":
     }
 
 
+def summarize_fleet(records: list) -> "dict | None":
+    """Fold a fleet run's `kind:"fleet"` events (serving/fleet.py,
+    fleet.jsonl) into the fleet block of the `cli perf` summary:
+    lifecycle counts (deaths -> respawns -> readmissions), routing
+    decisions (sheds / retries / hedge wins), rolling-reload recompile
+    total, and the last storm's throughput + latency SLOs. None when
+    the run never ran a fleet (no fleet events), so the block and the
+    compare rows only appear where the fleet ran."""
+    events = [
+        r for r in records if isinstance(r, dict) and r.get("kind") == "fleet"
+    ]
+    if not events:
+        return None
+
+    def count(*names: str) -> int:
+        return sum(1 for r in events if r.get("event") in names)
+
+    out = {
+        "fleet_events": len(events),
+        "fleet_deaths": count("death"),
+        "fleet_respawns": count("respawn"),
+        "fleet_evictions": count("evict"),
+        "fleet_readmissions": count("readmit"),
+        "fleet_sheds": count("shed"),
+        "fleet_retries": count("retry"),
+        "fleet_hedges": count("hedge"),
+        "fleet_hedge_wins": count("hedge-win"),
+        "fleet_reload_recompiles": sum(
+            r.get("recompiles", 0)
+            for r in events
+            if r.get("event") == "replica-reloaded"
+            and isinstance(r.get("recompiles"), int)
+        ),
+    }
+    stop = [r for r in events if r.get("event") == "fleet-stop"]
+    if stop:
+        out["fleet_gaveup"] = stop[-1].get("gaveup")
+    storms = [r for r in events if r.get("event") == "storm-summary"]
+    if storms:
+        storm = storms[-1]
+        out.update(
+            {
+                "fleet_requests": storm.get("requests"),
+                "fleet_completed": storm.get("completed"),
+                "fleet_shed_requests": storm.get("shed"),
+                "fleet_lost": storm.get("lost"),
+                "fleet_requests_per_sec": storm.get("requests_per_sec"),
+                "fleet_move_latency_ms_p50": storm.get(
+                    "move_latency_ms_p50"
+                ),
+                "fleet_move_latency_ms_p95": storm.get(
+                    "move_latency_ms_p95"
+                ),
+            }
+        )
+    return out
+
+
 # --- cross-run comparison ----------------------------------------------
 
 
@@ -553,6 +618,14 @@ def load_comparable(
     league = summarize_league(read_ledger(ledger, kinds={"league"}))
     if league is not None:
         summary.update(league)
+    # Fleet fold: fleet.jsonl (serving/fleet.py decision ledger) lives
+    # BESIDE the metrics ledger; fleet runs gain the fleet_* fields and
+    # with them the fleet SLO compare rows.
+    fleet_path = Path(ledger).parent / "fleet.jsonl"
+    if fleet_path.is_file():
+        fleet = summarize_fleet(read_ledger(fleet_path))
+        if fleet is not None:
+            summary.update(fleet)
     summary["source"] = str(ledger)
     return summary, str(ledger)
 

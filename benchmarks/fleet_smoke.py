@@ -1,0 +1,425 @@
+"""CI fleet gate: a loadgen storm survives replica murder, end to end.
+
+`make fleet-smoke` runs this. It proves, on any machine with no
+accelerator, that the serve fleet (docs/SERVING.md "Fleet") actually
+delivers its robustness contract:
+
+1. the fleet control plane (router + supervisor) imports and routes
+   with jax imports hard-blocked — the parent must outlive a wedged
+   replica, same contract as `cli supervise`;
+2. the storm: `cli fleet --smoke` drives N episode requests through
+   2 replicas while the chaos schedule fires —
+     - a rolling weight reload drains one replica at a time with
+       traffic flowing (asserted zero recompiles from the reply),
+     - a `hang-serve` fault (supervise/faults.py) wedges one replica's
+       dispatch mid-storm: its watchdog exits 113, `diagnose` reads
+       the unsealed `serve/b<B>` intent as `dispatch-hung`, the
+       quarantine policy respawns it onto a HALVED serve bucket, and
+       the probe re-admits it — the death -> verdict -> respawn ->
+       re-admission chain lands in order on fleet.jsonl,
+     - a SIGKILL takes a live replica late-storm (`chaos-kill`),
+   and the zero-lost invariant must hold regardless of interleaving:
+   `completed + shed == terminal == requests`, with p95 move latency
+   for the completed window inside a (generous, CPU) SLO.
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise. The fleet parent subprocess runs under the same jax import
+guard as stage 1 — jax may only live in the replica children.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPLICAS = 2
+SLOTS = 8
+REQUESTS = 96
+MAX_MOVES = 6
+#: p95 per-move latency SLO for the surviving window. Deliberately
+#: loose: the tiny CPU net serves moves in tens of ms, but CI boxes
+#: run 3+ python processes here — the gate is about not WEDGING.
+SLO_P95_MS = 2000.0
+
+# Chaos schedule (see the timeline note in stage_storm): reload first
+# on a stable fleet, hang mid-storm, SIGKILL late-storm. Calibrated
+# against the tiny board: games end naturally in ~3 moves, so a
+# replica completes ~1.3 episodes per dispatch wave — dispatch 12
+# lands around terminal ~30 fleet-wide, comfortably between the
+# reload and the kill even when the load skews.
+RELOAD_AFTER = 2
+HANG_AFTER_DISPATCH = 12
+KILL_AFTER = 72
+
+# Same import-guard preamble as chaos_smoke.py: any jax import in the
+# guarded subprocess raises.
+_NO_JAX_PREAMBLE = (
+    "import builtins, sys;"
+    "_real = builtins.__import__;\n"
+    "def _guard(name, *a, **k):\n"
+    "    if name == 'jax' or name.startswith('jax.'):\n"
+    "        raise ImportError('fleet parent must not import jax: ' + name)\n"
+    "    return _real(name, *a, **k)\n"
+    "builtins.__import__ = _guard\n"
+)
+
+
+def tiny_configs():
+    """chaos_smoke's tiny board/net (fast compile, fast moves); the
+    replica-side watchdog knobs ride the `cli fleet --replica-*`
+    flags instead of a TelemetryConfig."""
+    from alphatriangle_tpu.config import (
+        EnvConfig,
+        ModelConfig,
+        expected_other_features_dim,
+    )
+
+    env_cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+        LINE_MIN_LENGTH=3,
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[4],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=0,
+        RESIDUAL_BLOCK_FILTERS=4,
+        USE_TRANSFORMER=False,
+        FC_DIMS_SHARED=[16],
+        POLICY_HEAD_DIMS=[16],
+        VALUE_HEAD_DIMS=[16],
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        NUM_VALUE_ATOMS=11,
+        COMPUTE_DTYPE="float32",
+    )
+    return env_cfg, model_cfg
+
+
+def run_dir_for(root: str, run_name: str) -> Path:
+    from alphatriangle_tpu.config import PersistenceConfig
+
+    return PersistenceConfig(
+        ROOT_DATA_DIR=root, RUN_NAME=run_name
+    ).get_run_base_dir()
+
+
+def fleet_events(ledger: Path) -> list:
+    events = []
+    if not ledger.exists():
+        return events
+    for line in ledger.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "fleet":
+            events.append(rec)
+    return events
+
+
+class _ArmedFaults:
+    """chaos_smoke's context manager: arm the fault env (replica
+    children inherit os.environ) with a fresh sentinel state dir so
+    each fault fires exactly once across respawns."""
+
+    def __init__(self, spec: str, state_dir: Path) -> None:
+        self.spec = spec
+        self.state_dir = state_dir
+
+    def __enter__(self):
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        os.environ["ALPHATRIANGLE_FAULTS"] = self.spec
+        os.environ["ALPHATRIANGLE_FAULT_STATE_DIR"] = str(self.state_dir)
+        return self
+
+    def __exit__(self, *exc):
+        os.environ.pop("ALPHATRIANGLE_FAULTS", None)
+        os.environ.pop("ALPHATRIANGLE_FAULT_STATE_DIR", None)
+        return False
+
+
+def stage_jax_free_router(root: Path) -> int:
+    """The fleet control plane must import + route with jax blocked."""
+    code = (
+        _NO_JAX_PREAMBLE
+        + "from alphatriangle_tpu.serving.router import (\n"
+        + "    REJECT_NO_HEALTHY, ReplicaRouter)\n"
+        + "from alphatriangle_tpu.serving.fleet import FleetSupervisor\n"
+        + "from alphatriangle_tpu.serving import run_fleet_load\n"
+        + "router = ReplicaRouter([], timeout_s=1.0, retries=0)\n"
+        + "res = router.route({'kind': 'episode', 'seed': 0})\n"
+        + "assert not res.ok and res.rejection == REJECT_NO_HEALTHY, res\n"
+        + "assert router.backoff_delay(3) > 0\n"
+        + "print('fleet routed jax-free:', res.rejection)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=str(REPO),
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    if proc.returncode != 0:
+        print(
+            f"fleet-smoke: jax-free router gate failed "
+            f"(rc={proc.returncode})\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}",
+            file=sys.stderr,
+        )
+        return 2
+    print("fleet-smoke: router + supervisor import/route with jax blocked")
+    return 0
+
+
+def _fail(msg: str) -> int:
+    print(f"fleet-smoke: {msg}", file=sys.stderr)
+    return 2
+
+
+def stage_storm(root: Path) -> int:
+    """The chaos storm via `cli fleet --smoke` (jax-guarded parent).
+
+    Chaos timeline (calibrated against the tiny run: completions start
+    within a couple of waves; dispatch counters only reset on a
+    respawn, and nothing dies before the hang itself fires):
+      n >= RELOAD_AFTER (early)  rolling reload while both replicas
+                                 are still healthy — zero recompiles;
+      dispatch >= HANG_AFTER     hang-serve wedges the first replica
+                                 to reach it, mid-storm, while its
+                                 peer is serving;
+      n >= KILL_AFTER (late)     SIGKILL the first live replica.
+    The zero-lost accounting must close no matter how these overlap
+    with respawn warm-up windows (overlap windows shed, never lose).
+    """
+    run = "fleet_smoke"
+    run_dir = run_dir_for(str(root), run)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    env_cfg, model_cfg = tiny_configs()
+    (run_dir / "configs.json").write_text(
+        json.dumps(
+            {"env": env_cfg.model_dump(), "model": model_cfg.model_dump()}
+        )
+    )
+
+    argv = [
+        "fleet",
+        "--smoke",
+        "--run-name",
+        run,
+        "--root-dir",
+        str(root),
+        "--replicas",
+        str(REPLICAS),
+        "--slots",
+        str(SLOTS),
+        "--sims",
+        "2",
+        "--requests",
+        str(REQUESTS),
+        "--concurrency",
+        "8",
+        "--max-moves",
+        str(MAX_MOVES),
+        "--timeout",
+        "60",
+        "--retries",
+        "2",
+        "--route-backoff-base",
+        "0.1",
+        "--route-backoff-max",
+        "1.0",
+        "--hedge-after",
+        "2.0",
+        "--max-queue",
+        "64",
+        "--probe-deadline",
+        "10",
+        "--poll",
+        "0.25",
+        "--settle",
+        "90",
+        "--backoff-base",
+        "0.5",
+        "--backoff-max",
+        "4.0",
+        "--quarantine-after",
+        "1",
+        "--max-restarts",
+        "8",
+        "--circuit-breaker",
+        "6",
+        "--replica-health-interval",
+        "1.0",
+        "--replica-dispatch-min-deadline",
+        "2.0",
+        "--replica-dispatch-first-deadline",
+        "120",
+        "--replica-watchdog-poll",
+        "0.25",
+        "--tick-every",
+        "4",
+        "--chaos-kill-after",
+        str(KILL_AFTER),
+        "--reload-after",
+        str(RELOAD_AFTER),
+    ]
+    code = (
+        _NO_JAX_PREAMBLE
+        + "from alphatriangle_tpu.cli import main\n"
+        + f"sys.exit(main({argv!r}))\n"
+    )
+    with _ArmedFaults(
+        f"hang-serve@after={HANG_AFTER_DISPATCH}", root / "faults_fleet"
+    ):
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=str(REPO),
+            env={**os.environ, "PYTHONPATH": str(REPO)},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    report = None
+    for line in proc.stdout.splitlines():
+        if line.strip().startswith("{"):
+            try:
+                report = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    if proc.returncode != 0 or report is None:
+        tail = "\n".join(proc.stderr.splitlines()[-30:])
+        return _fail(
+            f"cli fleet --smoke failed (rc={proc.returncode}, "
+            f"report={'yes' if report else 'no'})\nstderr tail:\n{tail}"
+        )
+
+    # Zero-lost invariant (the --smoke gate checked it too; re-assert
+    # from the report so a gate regression can't pass silently).
+    if report["lost"] != 0:
+        return _fail(f"lost requests: {report['lost']} ({report})")
+    if report["completed"] <= 0:
+        return _fail(f"nothing completed: {report}")
+    if report["completed"] + report["shed"] != report["terminal"] or (
+        report["terminal"] != report["requests"]
+    ):
+        return _fail(
+            f"accounting leak: completed={report['completed']} "
+            f"shed={report['shed']} terminal={report['terminal']} "
+            f"requests={report['requests']}"
+        )
+    p95 = report.get("move_latency_ms_p95")
+    if p95 is None or p95 > SLO_P95_MS:
+        return _fail(
+            f"p95 move latency {p95}ms outside the {SLO_P95_MS:g}ms SLO"
+        )
+
+    events = fleet_events(Path(report["ledger"]))
+    deaths = [e for e in events if e.get("event") == "death"]
+    if len(deaths) < 2:
+        return _fail(
+            f"expected >= 2 deaths (hang + chaos kill), saw "
+            f"{[(d.get('replica'), d.get('rc')) for d in deaths]}"
+        )
+    if not any(e.get("event") == "chaos-kill" for e in events):
+        return _fail("no chaos-kill event on fleet.jsonl")
+    wedges = [
+        d
+        for d in deaths
+        if d.get("rc") == 113
+        and d.get("verdict") == "dispatch-hung"
+        and d.get("family") == "serve"
+    ]
+    if not wedges:
+        return _fail(
+            f"no watchdog wedge death (rc=113, dispatch-hung/serve): "
+            f"{[(d.get('rc'), d.get('verdict')) for d in deaths]}"
+        )
+
+    # The death -> verdict -> respawn -> re-admission chain, in ledger
+    # order, for the wedged replica.
+    victim = wedges[0].get("replica")
+    i_death = events.index(wedges[0])
+    i_respawn = next(
+        (
+            i
+            for i, e in enumerate(events)
+            if i > i_death
+            and e.get("event") == "respawn"
+            and e.get("replica") == victim
+        ),
+        None,
+    )
+    if i_respawn is None:
+        return _fail(f"wedged replica {victim} never respawned")
+    if not any(
+        e.get("event") == "readmit" and e.get("replica") == victim
+        for e in events[i_respawn:]
+    ):
+        return _fail(f"respawned replica {victim} never re-admitted")
+
+    # Quarantined respawn = graceful degradation onto a halved bucket.
+    respawn = events[i_respawn]
+    if not (respawn.get("slots") or SLOTS) < SLOTS:
+        return _fail(
+            f"wedged replica respawned at full bucket: {respawn}"
+        )
+
+    # Rolling weight swap with traffic flowing: zero recompiles.
+    reloaded = [e for e in events if e.get("event") == "replica-reloaded"]
+    if not reloaded:
+        return _fail("no replica-reloaded event (rolling swap skipped)")
+    hot = [e for e in reloaded if e.get("recompiles") not in (0, None)]
+    if hot:
+        return _fail(f"weight reload recompiled: {hot}")
+    if not any(e.get("event") == "reload-done" for e in events):
+        return _fail("rolling reload never completed")
+
+    print(
+        f"fleet-smoke: {report['completed']}/{report['requests']} served "
+        f"(+{report['shed']} shed, 0 lost) through "
+        f"{len(deaths)} deaths [{victim} wedge -> 113 -> dispatch-hung -> "
+        f"respawn@b{respawn.get('slots')} -> readmit], "
+        f"{len(reloaded)} hot reloads (0 recompiles), "
+        f"p95 {p95:.0f}ms, {report['elapsed_s']:.0f}s storm"
+    )
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root-dir", default=None)
+    args = parser.parse_args()
+
+    root = Path(args.root_dir or tempfile.mkdtemp(prefix="at_fleet_smoke_"))
+    t0 = time.monotonic()
+    try:
+        for stage in (stage_jax_free_router, stage_storm):
+            rc = stage(root)
+            if rc != 0:
+                return rc
+    finally:
+        if args.root_dir is None:
+            shutil.rmtree(root, ignore_errors=True)
+    print(f"fleet-smoke: OK ({time.monotonic() - t0:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

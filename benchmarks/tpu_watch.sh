@@ -26,6 +26,12 @@
 #                   in-window deaths (verdict-driven restarts from the
 #                   latest committed checkpoint); only exhausted budgets
 #                   (exit 115) or preemption (114) end the window.
+#   WATCH_FLEET     when set (and WATCH_CMD/WATCH_RUN are not), the
+#                   window runs a serve FLEET of this run name instead:
+#                   `cli fleet --run-name $WATCH_FLEET` (docs/SERVING.md
+#                   "Fleet"). The fleet parent self-heals replica
+#                   deaths (doctor-classified respawns, probe-gated
+#                   re-admission); fleet.jsonl is archived per window.
 #   WATCH_WARM_S    budget for the post-probe compile-cache warm
 #                   (default 900; 0 disables warming)
 #   WATCH_TUNE_S    budget for the offline autotune step (default 600;
@@ -39,6 +45,8 @@ cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + ${WATCH_BUDGET_S:-21600} ))
 if [ -n "${WATCH_RUN:-}" ]; then
   default_cmd="python -m alphatriangle_tpu.cli supervise --run-name ${WATCH_RUN} -- train"
+elif [ -n "${WATCH_FLEET:-}" ]; then
+  default_cmd="python -m alphatriangle_tpu.cli fleet --run-name ${WATCH_FLEET}"
 else
   default_cmd="bash benchmarks/tpu_round4.sh"
 fi
@@ -77,7 +85,7 @@ archive_window() {
   mkdir -p "$dest"
   for f in flight.jsonl flight.jsonl.1 health.json wedge_report.json \
            wedge_stacks.txt stall_stacks.txt trace.json \
-           supervisor.jsonl preempt_report.json; do
+           supervisor.jsonl preempt_report.json fleet.jsonl; do
     [ -f "$run_dir/$f" ] && cp "$run_dir/$f" "$dest/" 2>/dev/null
   done
   # Per-attempt report archives a supervised window's restarts left

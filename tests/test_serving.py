@@ -291,6 +291,98 @@ class TestPolicyService:
         assert serve_budget_bytes(record) > 0
 
 
+class TestConcurrentDrain:
+    def test_serve_stats_drain_races_dispatch_without_losing_requests(
+        self, serve_world
+    ):
+        """Regression: `serve_stats(drain=True)` from the telemetry
+        thread while the service thread dispatches. Before the snapshot
+        moved under the service lock, a drain landing mid-dispatch
+        could read the window lists and reset them around a concurrent
+        append — silently losing that dispatch's requests from the SLO
+        window. Invariant: every served request shows up in exactly one
+        drained window."""
+        import threading
+
+        service = make_service(serve_world)
+        sessions = service.open_sessions(
+            jax.random.split(jax.random.PRNGKey(21), 4)
+        )
+        drained: list[dict] = []
+        done = threading.Event()
+
+        def drainer():
+            while not done.is_set():
+                drained.append(service.serve_stats(drain=True))
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+        try:
+            for i in range(20):
+                for s in sessions:
+                    service.request_move(s.sid)
+                service.dispatch(rng=jax.random.PRNGKey(700 + i))
+        finally:
+            done.set()
+            t.join(timeout=10.0)
+        drained.append(service.serve_stats(drain=True))
+        assert (
+            sum(s["serve_window_requests"] for s in drained)
+            == service.requests_total
+            == 80
+        )
+        for s in sessions:
+            service.close_session(s.sid)
+
+    def test_emitter_drain_races_session_close_without_losing_episodes(
+        self,
+    ):
+        """Regression (league/emitter.py): `drain()` swapping the
+        finished list while `on_session_close` appends must not drop
+        episodes — the publication seam is lock-guarded. Driven with
+        synthetic open-row state, no env/extractor needed."""
+        import threading
+
+        from alphatriangle_tpu.league.emitter import TrajectoryEmitter
+
+        emitter = TrajectoryEmitter(None, None)
+        total = 200
+
+        def rows():
+            return {
+                "grid": [np.zeros((2, 2), dtype=np.float32)],
+                "other": [np.zeros(3, dtype=np.float32)],
+                "policy": [np.full(4, 0.25, dtype=np.float32)],
+                "reward": [1.0],
+                "version": [0],
+            }
+
+        for sid in range(total):
+            emitter._open[sid] = rows()
+        harvested = []
+        done = threading.Event()
+
+        def drainer():
+            while not done.is_set():
+                harvested.append(emitter.drain())
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+        try:
+            for sid in range(total):
+                emitter.on_session_close(
+                    sid, {"score": 1.0, "done": True}
+                )
+        finally:
+            done.set()
+            t.join(timeout=10.0)
+        harvested.append(emitter.drain())
+        episodes = sum(
+            r.num_episodes for r in harvested if r is not None
+        )
+        assert episodes == total == emitter.episodes_emitted
+
+
 class TestServeSummary:
     def test_perf_summary_carries_serve_fields(self):
         from alphatriangle_tpu.telemetry.perf import summarize_utilization
