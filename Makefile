@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke
 
 check: lint type test
 
@@ -111,6 +111,18 @@ chaos-smoke:
 # (completed + shed == requests) and p95 move latency inside the SLO.
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_smoke.py
+
+# Distributed-tracing + SLO gate (docs/OBSERVABILITY.md "Distributed
+# tracing & SLOs"): a 2-replica CPU storm with an aggressive hedge
+# trigger and an injected hang-serve wedge must leave trace_ids
+# consistent across fleet.jsonl, the replica flight rings, and the
+# `cli trace --fleet` merged Perfetto timeline — with flow arrows for
+# >= 1 hedged and >= 1 retried request in causal order — and the
+# `cli slo` exit-code contract (0 within budget / 1 burning / 2 no
+# data) must hold on pinned healthy/brownout/empty windows. Every
+# reader runs with jax imports hard-blocked.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_smoke.py
 
 # Kernel-library gate (docs/KERNELS.md): every interchangeable lowering
 # in alphatriangle_tpu/ops/ (gather_rows, backup_update, per_sample)

@@ -507,12 +507,46 @@ def cmd_trace(args: argparse.Namespace) -> int:
     """Summarize a run's host span trace (`trace.json`): per-span-name
     totals, busiest first, plus the file path for Perfetto/chrome
     loading. The spans are wall-clock, so they line up with any
-    `--profile` xplane device traces from the same run."""
+    `--profile` xplane device traces from the same run.
+
+    `--fleet` instead fuses a fleet-parent run dir's evidence — the
+    parent's route brackets + fleet.jsonl lifecycle + every replica's
+    flight ring and trace.json, clock-calibrated per process — into
+    ONE Perfetto timeline with flow arrows following each trace_id
+    from router queue-wait to the replica's `serve/b<B>` dispatch
+    wall (telemetry/merge.py)."""
     from .telemetry.tracer import summarize_trace_file
 
     run_dir = _resolve_run_dir(args.run, args.root_dir)
     if run_dir is None:
         return 1
+    if args.fleet:
+        from .telemetry.merge import merge_fleet_trace
+
+        try:
+            result = merge_fleet_trace(run_dir)
+        except FileNotFoundError:
+            print(
+                f"no fleet evidence in {run_dir} (fleet.jsonl missing — "
+                "not a fleet-parent run dir?)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"merged {result['events']:,} events from "
+            f"{result['processes']} process(es), "
+            f"{result['replicas']} replica dir(s) -> {result['path']}"
+        )
+        print(
+            f"  route spans {result['route_spans']:,}   "
+            f"flow arrows {result['flows']:,} over "
+            f"{len(result['flow_trace_ids']):,} trace id(s)"
+        )
+        print(
+            f"\nfull fleet timeline: load {result['path']} in "
+            "https://ui.perfetto.dev or chrome://tracing"
+        )
+        return 0
     path = run_dir / "trace.json"
     try:
         rows = summarize_trace_file(path, top=args.top)
@@ -549,8 +583,11 @@ def cmd_watch(args: argparse.Namespace) -> int:
     import time as _time
 
     from .stats.watch import (
+        FleetWatchState,
         WatchState,
+        fleet_line,
         render_frame,
+        tail_fleet,
         tail_flight,
         tail_ledger_utils,
         tail_live_metrics,
@@ -564,17 +601,40 @@ def cmd_watch(args: argparse.Namespace) -> int:
     live = run_dir / "live_metrics.jsonl"
     ledger = run_dir / "metrics.jsonl"
     flight = run_dir / FLIGHT_FILENAME
+    fleet_ledger = run_dir / "fleet.jsonl"
     heartbeat = run_dir / "health.json"
     state = WatchState()
+    fleet_state = FleetWatchState()
     offset = tail_live_metrics(live, state, 0)
     ledger_offset = tail_ledger_utils(ledger, state, 0)
     flight_offset = tail_flight(flight, state, 0)
-    if not live.exists():
+    fleet_offset = tail_fleet(fleet_ledger, fleet_state, 0)
+
+    def fleet_extra() -> str:
+        """Fleet-parent run dirs get the routing vitals + the SLO
+        roll-up appended under the standard frame; training run dirs
+        (no fleet.jsonl) render nothing extra."""
+        fl = fleet_line(fleet_state)
+        if fl is None:
+            return ""
+        extra = "\n" + fl
+        try:
+            from .telemetry.slo import evaluate_slos, slo_status_line
+
+            extra += "\n  " + slo_status_line(evaluate_slos(run_dir))
+        except Exception:  # the SLO line must never kill the console
+            pass
+        return extra
+
+    if not live.exists() and not fleet_ledger.exists():
         print(
             f"waiting for {live} (run still starting?) — Ctrl-C to stop",
             file=sys.stderr,
         )
-    frame = render_frame(state, run_dir.name, health=read_health(heartbeat))
+    frame = (
+        render_frame(state, run_dir.name, health=read_health(heartbeat))
+        + fleet_extra()
+    )
     print(frame, flush=True)
     if args.once:
         return 0
@@ -584,10 +644,14 @@ def cmd_watch(args: argparse.Namespace) -> int:
             offset = tail_live_metrics(live, state, offset)
             ledger_offset = tail_ledger_utils(ledger, state, ledger_offset)
             flight_offset = tail_flight(flight, state, flight_offset)
+            fleet_offset = tail_fleet(fleet_ledger, fleet_state, fleet_offset)
             # Redraw in place: move up over the previous frame.
             height = frame.count("\n") + 1
-            frame = render_frame(
-                state, run_dir.name, health=read_health(heartbeat)
+            frame = (
+                render_frame(
+                    state, run_dir.name, health=read_health(heartbeat)
+                )
+                + fleet_extra()
             )
             print(f"\x1b[{height}F\x1b[0J" + frame, flush=True)
     except KeyboardInterrupt:
@@ -1733,6 +1797,26 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         "fleet": fleet.summary(),
         "ledger": str(run_dir / "fleet.jsonl"),
     }
+    # Aggregated whole-fleet scrape surface + SLO snapshot
+    # (telemetry/slo.py): rejection codes as DISTINCT counters, per-SLO
+    # burn rates as gauges — written after the storm so one textfile
+    # describes the whole run.
+    from .telemetry.ledger import read_ledger as _read_ledger
+    from .telemetry.perf import summarize_fleet as _summarize_fleet
+    from .telemetry.slo import (
+        FLEET_PROM_FILENAME,
+        evaluate_slos,
+        write_fleet_prometheus,
+    )
+
+    slo_report = evaluate_slos(run_dir)
+    write_fleet_prometheus(
+        run_dir / FLEET_PROM_FILENAME,
+        _summarize_fleet(_read_ledger(run_dir / "fleet.jsonl")),
+        slo_report,
+        run_name=args.run_name or run_dir.name,
+    )
+    report["slo"] = slo_report["status"]
     print(_json.dumps(report))
     if args.smoke:
         accounted = (
@@ -2114,6 +2198,90 @@ def cmd_lint(args: argparse.Namespace) -> int:
     return report.exit_code
 
 
+def cmd_slo(args: argparse.Namespace) -> int:
+    """Fleet SLO report (telemetry/slo.py): availability, p95 move
+    latency, and dispatch success evaluated as error budgets with
+    multi-window burn-rate alerts, purely from records the fleet
+    already ledgered. Never imports JAX.
+
+    Exit code IS the alert state: 0 every SLO within budget, 1 at
+    least one window burning past its threshold, 2 no data (not a
+    fleet run dir, or nothing ledgered yet) — pinned by tests and the
+    trace-smoke's healthy/brownout contract."""
+    import json as _json
+
+    from .telemetry.slo import (
+        FLEET_PROM_FILENAME,
+        SLO_EXIT_CODES,
+        evaluate_slos,
+        slo_status_line,
+        write_fleet_prometheus,
+    )
+
+    target = Path(args.run) if args.run else None
+    if target is not None and target.is_dir():
+        run_dir = target
+    else:
+        run_dir = _resolve_run_dir(args.run, args.root_dir)
+        if run_dir is None:
+            return SLO_EXIT_CODES["no-data"]
+    windows = None
+    if args.window:
+        try:
+            windows = tuple(
+                (float(w.split(":")[0]), float(w.split(":")[1]))
+                for w in args.window
+            )
+        except (ValueError, IndexError):
+            print(
+                f"bad --window {args.window!r}: want SECONDS:BURN "
+                "(e.g. 300:14.4)",
+                file=sys.stderr,
+            )
+            return SLO_EXIT_CODES["no-data"]
+    kw = {"windows": windows} if windows else {}
+    report = evaluate_slos(
+        run_dir,
+        now=args.now,
+        latency_threshold_ms=args.latency_threshold,
+        **kw,
+    )
+    if args.prom:
+        from .telemetry.ledger import read_ledger
+        from .telemetry.perf import summarize_fleet
+
+        write_fleet_prometheus(
+            run_dir / FLEET_PROM_FILENAME,
+            summarize_fleet(read_ledger(run_dir / "fleet.jsonl")),
+            report,
+            run_name=run_dir.name,
+        )
+    if args.json:
+        print(_json.dumps(report))
+        return int(report["exit_code"])
+    print(f"slo {run_dir}")
+    print(f"  {slo_status_line(report)}")
+    for slo in report["slos"]:
+        print(
+            f"  {slo['name']:<18} objective {slo['objective']:.2%}  "
+            f"budget {slo['error_budget']:.2%}  [{slo['status']}]"
+        )
+        for w in slo["windows"]:
+            flag = "  BURNING" if w["burning"] else ""
+            print(
+                f"    window {w['window_s']:>6g}s  "
+                f"total {w['total']:>10,.0f}  bad {w['bad']:>8,.0f}  "
+                f"err {w['error_rate']:.4f}  "
+                f"burn x{w['burn_rate']:,.1f} "
+                f"(alert at x{w['burn_threshold']:g}){flag}"
+            )
+    print(
+        f"  status    {report['status']} "
+        f"(exit {report['exit_code']})"
+    )
+    return int(report["exit_code"])
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Postmortem window forensics: classify how a run ended from its
     on-disk evidence alone (flight ring + health.json + wedge report +
@@ -2149,6 +2317,44 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         run_dir = _resolve_run_dir(args.run, args.root_dir)
         if run_dir is None:
             return 2
+    if (run_dir / "fleet.jsonl").exists():
+        # Fleet-parent run dir: no learner heartbeat, no device
+        # dispatches of its own — classify_run would misread it as
+        # never-started. Classify from the fleet ledger + per-replica
+        # death verdicts instead (serving/fleet.py classify_fleet).
+        from .serving.fleet import classify_fleet
+
+        verdict = classify_fleet(run_dir)
+        if args.json:
+            verdict["run_dir"] = str(run_dir)
+            print(_json.dumps(verdict))
+            return int(verdict["exit_code"])
+        ev = verdict["evidence"]
+        print(f"doctor {run_dir} (fleet parent)")
+        print(
+            f"  verdict   {verdict['verdict']}"
+            + (
+                f"  ({verdict['program']} [{verdict['family']}])"
+                if verdict.get("program")
+                else ""
+            )
+        )
+        if verdict.get("detail"):
+            print(f"  detail    {verdict['detail']}")
+        print(
+            f"  evidence  {ev['fleet_events']} fleet events, "
+            f"{ev['deaths']} deaths, {ev['respawns']} respawns, "
+            f"{ev['evictions']} evictions, {len(ev['gaveup'])} gave up"
+            + (", fleet-stop" if ev["fleet_stop"] else ", NO fleet-stop")
+            + (", storm summary" if ev.get("storm_summary") else "")
+            + (
+                f", {ev['unsealed_route_intents']} unsealed route "
+                "intent(s)"
+                if ev.get("unsealed_route_intents")
+                else ""
+            )
+        )
+        return int(verdict["exit_code"])
     flight = read_flight(run_dir / FLIGHT_FILENAME)
     health = read_health(run_dir / "health.json")
     wedge = read_wedge_report(run_dir / WEDGE_REPORT_FILENAME)
@@ -2559,6 +2765,51 @@ def main(argv: list[str] | None = None) -> int:
         "it to windows.jsonl).",
     )
 
+    slo = sub.add_parser(
+        "slo",
+        help="Fleet SLO report: error budgets + multi-window burn-rate "
+        "alerts from the fleet's ledgers. Exit 0 within budget, "
+        "1 burning, 2 no data. No JAX import.",
+    )
+    slo.add_argument(
+        "run",
+        nargs="?",
+        default=None,
+        help="Run name or fleet-parent run dir (default: latest run).",
+    )
+    slo.add_argument("--root-dir", default=None)
+    slo.add_argument(
+        "--json",
+        action="store_true",
+        help="Emit the full alphatriangle.slo.v1 report as one JSON line.",
+    )
+    slo.add_argument(
+        "--latency-threshold",
+        type=float,
+        default=500.0,
+        help="p95 move-latency SLO threshold in ms (default 500).",
+    )
+    slo.add_argument(
+        "--window",
+        action="append",
+        default=None,
+        metavar="SECONDS:BURN",
+        help="Override burn-rate windows (repeatable), e.g. 300:14.4 "
+        "3600:6. Default: the SRE fast-page/slow-ticket pair.",
+    )
+    slo.add_argument(
+        "--now",
+        type=float,
+        default=None,
+        help="Evaluate at this epoch time instead of the newest record "
+        "(replay the alert state mid-brownout).",
+    )
+    slo.add_argument(
+        "--prom",
+        action="store_true",
+        help="Also (re)write the aggregated fleet.prom textfile.",
+    )
+
     supervise = sub.add_parser(
         "supervise",
         help="Self-healing parent for train/league: restart a dead "
@@ -2708,6 +2959,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     trace.add_argument("--root-dir", default=None)
     trace.add_argument("--top", type=int, default=20)
+    trace.add_argument(
+        "--fleet",
+        action="store_true",
+        help="Fuse a fleet-parent run dir (parent route brackets + "
+        "fleet.jsonl + every replica's flight ring and trace.json, "
+        "clock-calibrated) into one Perfetto timeline with flow "
+        "arrows per trace_id (trace_fleet.json).",
+    )
 
     an = sub.add_parser(
         "analyze", help="Summarize per-phase timer dumps from a profile run."
@@ -3344,6 +3603,7 @@ def main(argv: list[str] | None = None) -> int:
         "watch": cmd_watch,
         "health": cmd_health,
         "doctor": cmd_doctor,
+        "slo": cmd_slo,
         "supervise": cmd_supervise,
         "perf": cmd_perf,
         "compare": cmd_compare,

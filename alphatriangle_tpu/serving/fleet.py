@@ -43,9 +43,16 @@ from pathlib import Path
 
 from ..supervise.policy import RecoveryPolicy
 from ..supervise.supervisor import diagnose
-from ..telemetry.flight import FlightRecorder
+from ..telemetry import tracectx
+from ..telemetry.flight import (
+    DOCTOR_EXIT_CODES,
+    FLIGHT_FILENAME,
+    FlightRecorder,
+    read_flight,
+    unsealed_intents,
+)
 from ..telemetry.health import PROBE_LIVE, probe_run
-from ..telemetry.ledger import MetricsLedger
+from ..telemetry.ledger import MetricsLedger, iter_jsonl_records, ledger_paths
 from .router import ReplicaError, ReplicaRouter
 
 logger = logging.getLogger(__name__)
@@ -110,6 +117,10 @@ class ProcessReplicaHandle:
         self.probe_ok = False
         self.ready = threading.Event()
         self.ready_info: "dict | None" = None
+        # Fired (handle, ready_msg) when an incarnation's ready line
+        # lands — the fleet supervisor ledgers the replica's
+        # (monotonic, wall) clock pair for trace merge calibration.
+        self.on_ready = None
         self.served_moves = 0  # progress signal for the recovery policy
         self.episodes_ok = 0
         self._lock = threading.Lock()
@@ -195,6 +206,13 @@ class ProcessReplicaHandle:
                 if msg.get("kind") == "ready" and "id" not in msg:
                     self.ready_info = msg
                     self.ready.set()
+                    if self.on_ready is not None:
+                        try:
+                            self.on_ready(self, msg)
+                        except Exception:
+                            logger.exception(
+                                "%s on_ready hook failed", self.name
+                            )
                     continue
                 with self._lock:
                     pending = self._pending.pop(msg.get("id"), None)
@@ -278,6 +296,14 @@ class FleetSupervisor:
             ProcessReplicaHandle(f"r{i}", self.run_dir / f"replica_r{i}")
             for i in range(replicas)
         ]
+        for h in self.handles:
+            h.on_ready = self._on_replica_ready
+        # Fleet-lifetime root trace (telemetry/tracectx.py); each
+        # replica incarnation spawns under a child of it, handed to the
+        # replica process via the traceparent env seam so its own
+        # telemetry links back to the spawn event.
+        self.trace_ctx = tracectx.mint(parent=tracectx.from_env())
+        self._spawn_ctx: dict[str, tracectx.TraceContext] = {}
         self._policies = {h.name: policy_factory() for h in self.handles}
         self._spawn_t: dict[str, float] = {}
         self._attempts: dict[str, int] = {h.name: 0 for h in self.handles}
@@ -374,12 +400,19 @@ class FleetSupervisor:
         stderr_log = open(  # noqa: SIM115 — lives as long as the child
             handle.run_dir / "replica.stderr.log", "ab"
         )
+        # Each incarnation gets a child trace context, handed down via
+        # the env seam (the replica's RunTelemetry adopts it as the
+        # base trace on its flight ring) and stamped on the spawn and
+        # death events so one trace_id follows the incarnation.
+        ctx = self.trace_ctx.child()
+        self._spawn_ctx[handle.name] = ctx
         proc = self._popen(
             argv,
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=stderr_log,
             text=True,
+            env=tracectx.child_env(ctx),
         )
         stderr_log.close()
         self._spawn_t[handle.name] = self._now()
@@ -391,6 +424,25 @@ class FleetSupervisor:
             slots=bucket,
             attempt=attempt,
             overrides=self._overrides.get(handle.name) or {},
+            **ctx.fields(),
+        )
+
+    def _on_replica_ready(self, handle: ProcessReplicaHandle, msg: dict) -> None:
+        """Ledger a replica's ready line — most importantly its
+        `(t_mono, time)` clock pair, the calibration sample
+        telemetry/merge.py uses to place that process's monotonic
+        timestamps on the shared wall-clock timeline."""
+        ctx = self._spawn_ctx.get(handle.name)
+        self._event(
+            "replica-ready",
+            replica=handle.name,
+            generation=handle.generation,
+            replica_pid=msg.get("pid"),
+            slots=msg.get("slots"),
+            warm_aot=msg.get("warm_aot"),
+            t_mono=msg.get("t_mono"),
+            replica_time=msg.get("time"),
+            **(ctx.fields() if ctx is not None else {}),
         )
 
     def start(self, wait_ready: bool = True) -> None:
@@ -462,6 +514,7 @@ class FleetSupervisor:
             progress_step=handle.served_moves,
         )
         self.deaths += 1
+        ctx = self._spawn_ctx.get(handle.name)
         self._event(
             "death",
             replica=handle.name,
@@ -475,6 +528,7 @@ class FleetSupervisor:
             delay_s=action.delay_s,
             overrides=action.overrides,
             reason=action.reason,
+            **(ctx.fields() if ctx is not None else {}),
         )
         logger.warning(
             "replica %s died (rc=%s, verdict=%s) -> %s: %s",
@@ -649,6 +703,7 @@ def run_fleet_load(
     moves_window = [0]
     t_start = time.monotonic()
     last_tick = [t_start]
+    last_n = [0]  # terminal outcomes already reported in a prior tick
 
     def worker() -> None:
         while True:
@@ -673,10 +728,18 @@ def run_fleet_load(
                 if tick_due:
                     window = now - last_tick[0]
                     moves, moves_window[0] = moves_window[0], 0
+                    # Windowed, not cumulative: the SLO engine
+                    # (telemetry/slo.py) integrates rate * window_s per
+                    # tick, so each request must be counted once.
+                    win_requests = n - last_n[0]
+                    last_n[0] = n
                     last_tick[0] = now
             if tick_due:
                 fleet.util_tick(
-                    step=n, moves=moves, requests=n, window_s=window
+                    step=n,
+                    moves=moves,
+                    requests=win_requests,
+                    window_s=window,
                 )
             if on_complete is not None:
                 try:
@@ -694,15 +757,13 @@ def run_fleet_load(
         t.join()
     elapsed = max(1e-9, time.monotonic() - t_start)
     if fleet is not None:
+        # Final tick covers only the tail window since the last mid-
+        # storm tick (same once-per-request accounting as above).
         fleet.util_tick(
             step=len(results),
-            moves=sum(
-                int(r.value.get("moves") or 0)
-                for r in results
-                if r.ok and r.value
-            ),
-            requests=len(results),
-            window_s=elapsed,
+            moves=moves_window[0],
+            requests=len(results) - last_n[0],
+            window_s=max(1e-9, time.monotonic() - last_tick[0]),
         )
 
     completed = [r for r in results if r.ok]
@@ -742,3 +803,148 @@ def run_fleet_load(
     if fleet is not None:
         fleet._event("storm-summary", **summary)
     return summary
+
+
+# --- postmortem readers (no JAX import anywhere on this path) -----------
+
+
+def read_fleet_events(run_dir: "Path | str") -> list[dict]:
+    """All parseable `kind:"fleet"` events across ledger rotations,
+    oldest first — the same tolerant-reader contract as read_flight
+    (torn tails and legacy id-less records parse fine)."""
+    out: list[dict] = []
+    for p in ledger_paths(Path(run_dir) / FLEET_FILENAME):
+        out.extend(iter_jsonl_records(p, kinds={"fleet"}))
+    return out
+
+
+def classify_fleet(run_dir: "Path | str") -> dict:
+    """Postmortem classifier for a FLEET-PARENT run dir (the `cli
+    doctor` branch for dirs holding a fleet.jsonl — a fleet parent has
+    no learner heartbeat, so `classify_run` would misread it as
+    never-started).
+
+    Verdicts reuse the DOCTOR_EXIT_CODES vocabulary, strongest
+    evidence first:
+
+    - `dispatch-hung`: the parent died holding routed requests — an
+      unsealed `fleet/route` intent in the parent's own flight ring
+      with no `fleet-stop` event.
+    - a replica verdict: the parent died mid-run (no `fleet-stop`)
+      right after a replica death, or gave a replica up — the fleet's
+      verdict is that replica's ledgered death verdict (SIGKILL-style
+      clean crash-loops surface as `host-stall` with the loop named).
+    - `host-stall`: the parent died between routed requests (no
+      `fleet-stop`, no death to blame).
+    - `never-started`: a fleet.jsonl exists but holds no events.
+    - `clean`: `fleet-stop` was written — the fleet ran to completion;
+      deaths/respawns along the way were healed (the self-healing
+      contract) and ride in the evidence.
+
+    Returns the classify_run result shape:
+    {verdict, exit_code, program, family, detail, evidence}.
+    """
+    run_dir = Path(run_dir)
+    events = read_fleet_events(run_dir)
+    by_event: dict[str, list[dict]] = {}
+    for e in events:
+        by_event.setdefault(str(e.get("event")), []).append(e)
+    deaths = by_event.get("death", [])
+    gaveup = sorted(
+        {str(e.get("replica")) for e in by_event.get("give-up", [])}
+    )
+    stopped = bool(by_event.get("fleet-stop"))
+    torn_route = [
+        r
+        for r in unsealed_intents(read_flight(run_dir / FLIGHT_FILENAME))
+        if r.get("family") == "fleet"
+    ]
+    evidence = {
+        "fleet_events": len(events),
+        "deaths": len(deaths),
+        "respawns": len(by_event.get("respawn", [])),
+        "evictions": len(by_event.get("evict", [])),
+        "gaveup": gaveup,
+        "fleet_stop": stopped,
+        "storm_summary": bool(by_event.get("storm-summary")),
+        "unsealed_route_intents": len(torn_route),
+    }
+
+    def result(verdict, program=None, family=None, detail=""):
+        return {
+            "verdict": verdict,
+            "exit_code": DOCTOR_EXIT_CODES[verdict],
+            "program": program,
+            "family": family,
+            "detail": detail,
+            "evidence": evidence,
+        }
+
+    def replica_verdict(death: dict, why: str) -> dict:
+        verdict = str(death.get("verdict"))
+        replica = death.get("replica")
+        if verdict in DOCTOR_EXIT_CODES and verdict not in (
+            "clean",
+            "never-started",
+        ):
+            return result(
+                verdict,
+                program=death.get("program"),
+                family=death.get("family"),
+                detail=f"{why}: replica {replica} died with verdict "
+                f"{verdict} (rc={death.get('rc')})",
+            )
+        return result(
+            "host-stall",
+            detail=f"{why}: replica {replica} crash-looped "
+            f"(last death rc={death.get('rc')}, verdict "
+            f"{verdict or 'unknown'})",
+        )
+
+    if not events:
+        return result(
+            "never-started",
+            detail="fleet.jsonl exists but holds no events: the parent "
+            "died before spawning its first replica",
+        )
+    if torn_route and not stopped:
+        intent = torn_route[-1]
+        return result(
+            "dispatch-hung",
+            program=str(intent.get("program")),
+            family="fleet",
+            detail="fleet parent died holding "
+            f"{len(torn_route)} routed request(s) in flight "
+            f"(last seq {intent.get('seq')}, "
+            f"trace {intent.get('trace_id') or 'untraced'})",
+        )
+    if not stopped:
+        if deaths:
+            return replica_verdict(
+                deaths[-1], "fleet parent died mid-run (no fleet-stop)"
+            )
+        return result(
+            "host-stall",
+            detail="fleet parent died between routed requests: no "
+            "fleet-stop event and no replica death to blame",
+        )
+    if gaveup:
+        for death in reversed(deaths):
+            if str(death.get("replica")) in gaveup:
+                return replica_verdict(
+                    death,
+                    "fleet completed degraded (gave up on "
+                    f"{', '.join(gaveup)})",
+                )
+        return result(
+            "host-stall",
+            detail="fleet completed degraded: gave up on "
+            f"{', '.join(gaveup)} with no ledgered death verdict",
+        )
+    stop = by_event["fleet-stop"][-1]
+    return result(
+        "clean",
+        detail="fleet ran to completion: "
+        f"{stop.get('deaths', 0)} death(s), "
+        f"{stop.get('respawns', 0)} respawn(s), all healed",
+    )

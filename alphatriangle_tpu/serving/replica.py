@@ -41,21 +41,37 @@ import sys
 import threading
 import time
 
+from ..telemetry import tracectx
+
 logger = logging.getLogger(__name__)
 
 READY_KIND = "ready"
 
 
-class _Episode:
-    __slots__ = ("req_id", "sid", "seed", "max_moves", "moves", "lat_ms")
+def _clock_pair() -> dict:
+    """This process's `(monotonic, wall)` clock sample — stamped on
+    ready/ping replies so the fleet merge (telemetry/merge.py) can
+    calibrate each replica's monotonic clock against shared wall time."""
+    return {"t_mono": time.monotonic(), "time": time.time()}
 
-    def __init__(self, req_id, sid, seed, max_moves):
+
+class _Episode:
+    __slots__ = (
+        "req_id", "sid", "seed", "max_moves", "moves", "lat_ms",
+        "trace", "t0_ns",
+    )
+
+    def __init__(self, req_id, sid, seed, max_moves, trace=None):
         self.req_id = req_id
         self.sid = sid
         self.seed = seed
         self.max_moves = max_moves
         self.moves = 0
         self.lat_ms: list = []
+        # Trace-context fields of the routed request driving this
+        # episode (telemetry/tracectx.py); empty for legacy callers.
+        self.trace: dict = trace or {}
+        self.t0_ns = time.time_ns()
 
 
 class ReplicaServer:
@@ -87,6 +103,20 @@ class ReplicaServer:
         except Exception:
             summary = {}
         done = bool(summary.get("done"))
+        # The episode's lane in this replica's trace.json: one complete
+        # span from request arrival to reply, carrying the routed
+        # request's trace ids so the fleet merge can draw the
+        # router -> replica flow arrow.
+        tracer = getattr(self.telemetry, "tracer", None)
+        if tracer is not None:
+            tracer.complete(
+                "replica/episode",
+                ep.t0_ns,
+                time.time_ns(),
+                moves=ep.moves,
+                ok=ok,
+                **ep.trace,
+            )
         self.reply(
             {
                 "id": ep.req_id,
@@ -97,6 +127,7 @@ class ReplicaServer:
                 "done": done,
                 "score": summary.get("score"),
                 "lat_ms": [round(v, 3) for v in ep.lat_ms],
+                **ep.trace,
                 **({"error": error} if error else {}),
             }
         )
@@ -162,19 +193,33 @@ class ReplicaServer:
         kind = req.get("kind")
         rid = req.get("id")
         if kind == "episode":
+            trace = tracectx.trace_fields(req)
             try:
                 s = self.service.open_session(seed=int(req.get("seed", 0)))
             except Exception as exc:
                 self.reply(
-                    {"id": rid, "ok": False, "kind": kind, "error": str(exc)}
+                    {
+                        "id": rid,
+                        "ok": False,
+                        "kind": kind,
+                        "error": str(exc),
+                        **trace,
+                    }
                 )
                 return True
+            set_trace = getattr(self.service, "set_session_trace", None)
+            if set_trace is not None and trace:
+                set_trace(s.sid, trace)
             # Register BEFORE request_move: the dispatcher may serve
             # the very next wave, and a result for an unregistered sid
             # would be dropped (wedging the episode forever).
             with self._cond:
                 self._active[s.sid] = _Episode(
-                    rid, s.sid, req.get("seed"), int(req.get("max_moves", 64))
+                    rid,
+                    s.sid,
+                    req.get("seed"),
+                    int(req.get("max_moves", 64)),
+                    trace=trace,
                 )
             try:
                 self.service.request_move(s.sid)
@@ -201,6 +246,7 @@ class ReplicaServer:
                     "pid": os.getpid(),
                     "queue_depth": self.service.queue_depth,
                     "dispatches": self.service.dispatch_count,
+                    **_clock_pair(),
                 }
             )
             return True
@@ -387,6 +433,7 @@ def main(argv: "list | None" = None) -> int:
             "pid": os.getpid(),
             "slots": args.slots,
             "warm_aot": bool(aot),
+            **_clock_pair(),
         }
     )
     try:

@@ -36,6 +36,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..telemetry import tracectx
 from ..telemetry.flight import flight_span
 
 logger = logging.getLogger(__name__)
@@ -72,6 +73,7 @@ class RouteResult:
     hedged: bool = False
     hedge_won: bool = False
     wait_s: float = 0.0
+    trace_id: str | None = None
 
 
 @dataclass
@@ -158,7 +160,11 @@ class ReplicaRouter:
         if self.on_event is None:
             return
         try:
-            self.on_event({"event": event, **fields})
+            # Every decision carries the admission level so a tailer
+            # (`cli watch`) can show queue pressure without polling.
+            self.on_event(
+                {"event": event, "inflight": self._inflight, **fields}
+            )
         except Exception:
             logger.exception("router on_event hook failed for %r", event)
 
@@ -186,18 +192,32 @@ class ReplicaRouter:
     def route(self, payload: dict, timeout_s: "float | None" = None) -> RouteResult:
         """Route one request to a terminal outcome (never raises for
         replica-side failures — shed/exhausted outcomes carry their
-        rejection code and last error instead)."""
+        rejection code and last error instead).
+
+        Every request is minted a trace context (telemetry/tracectx.py)
+        — a child of any context already on the payload (a caller
+        propagating its own trace), else a fresh root trace. The triple
+        rides the payload to the replica, every router event, and the
+        `fleet/route` flight bracket, so the merged fleet timeline can
+        follow this exact request across the process boundary."""
         timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        ctx = tracectx.mint(parent=tracectx.TraceContext.from_fields(payload))
+        trace = ctx.fields()
+        payload = {**payload, **trace}
         with self._lock:
             self.stats.requests += 1
             if self._inflight >= self.max_inflight:
                 self.stats.shed_queue_full += 1
-                result = RouteResult(ok=False, rejection=REJECT_QUEUE_FULL)
+                result = RouteResult(
+                    ok=False,
+                    rejection=REJECT_QUEUE_FULL,
+                    trace_id=ctx.trace_id,
+                )
                 self._emit(
                     "shed",
                     rejection=REJECT_QUEUE_FULL,
-                    inflight=self._inflight,
                     kind=payload.get("kind"),
+                    **trace,
                 )
                 return result
             self._inflight += 1
@@ -208,19 +228,24 @@ class ReplicaRouter:
                 "fleet",
                 ROUTE_PROGRAM,
                 avals=str(payload.get("kind", "request")),
+                trace=trace,
             ):
-                result = self._attempt_loop(payload, timeout_s)
+                result = self._attempt_loop(payload, timeout_s, trace)
         finally:
             with self._lock:
                 self._inflight -= 1
         result.wait_s = self._clock() - t0
+        result.trace_id = ctx.trace_id
         if result.ok:
             with self._lock:
                 self.stats.completed += 1
         return result
 
-    def _attempt_loop(self, payload: dict, timeout_s: float) -> RouteResult:
+    def _attempt_loop(
+        self, payload: dict, timeout_s: float, trace: "dict | None" = None
+    ) -> RouteResult:
         tried: list = []
+        trace = trace or {}
         last_error: "Exception | None" = None
         attempt = 0
         while attempt <= self.retries:
@@ -234,6 +259,7 @@ class ReplicaRouter:
                     attempts=attempt,
                     error=str(last_error) if last_error else None,
                     kind=payload.get("kind"),
+                    **trace,
                 )
                 return RouteResult(
                     ok=False,
@@ -252,10 +278,13 @@ class ReplicaRouter:
                     attempt=attempt,
                     delay_s=delay,
                     error=str(last_error) if last_error else None,
+                    **trace,
                 )
                 self._sleep(delay)
             tried.append(target.name)
-            result = self._dispatch_one(target, payload, timeout_s, tried)
+            result = self._dispatch_one(
+                target, payload, timeout_s, tried, trace
+            )
             if result.ok:
                 result.attempts = attempt + 1
                 return result
@@ -268,6 +297,7 @@ class ReplicaRouter:
             attempts=attempt,
             error=str(last_error) if last_error else None,
             kind=payload.get("kind"),
+            **trace,
         )
         return RouteResult(
             ok=False,
@@ -277,7 +307,12 @@ class ReplicaRouter:
         )
 
     def _dispatch_one(
-        self, primary, payload: dict, timeout_s: float, tried: list
+        self,
+        primary,
+        payload: dict,
+        timeout_s: float,
+        tried: list,
+        trace: "dict | None" = None,
     ) -> RouteResult:
         """One attempt on `primary`, optionally hedged onto a second
         replica after `hedge_after_s`. First finished copy wins; the
@@ -324,6 +359,7 @@ class ReplicaRouter:
                         "hedge-win",
                         replica=hedge_target.name,
                         primary=primary.name,
+                        **(trace or {}),
                     )
                     return RouteResult(
                         ok=True,
@@ -371,6 +407,7 @@ class ReplicaRouter:
                             "hedge",
                             primary=primary.name,
                             backup=hedge_target.name,
+                            **(trace or {}),
                         )
                     except Exception:
                         hedge = None

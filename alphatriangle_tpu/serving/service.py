@@ -118,6 +118,11 @@ class PolicyService:
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.RLock()
         self._queue: deque[int] = deque()  # sids with a pending request
+        # sid -> trace-context fields of the request currently driving
+        # that session (telemetry/tracectx.py): the replica front end
+        # registers them so the serve/b<B> flight bracket can name the
+        # exact trace_ids each device wave served.
+        self._session_trace: dict[int, dict] = {}
         # Cumulative counters (UtilizationMeter folds deltas).
         self.dispatch_count = 0
         self.requests_total = 0
@@ -196,10 +201,21 @@ class PolicyService:
         with self._lock:
             return self.sessions.admit_many(reset_keys)
 
+    def set_session_trace(self, sid: int, fields: "dict | None") -> None:
+        """Attach (or clear) the trace-context fields of the request
+        currently driving session `sid` — stamped onto the serve
+        dispatch bracket and the session's result dicts."""
+        with self._lock:
+            if fields:
+                self._session_trace[sid] = dict(fields)
+            else:
+                self._session_trace.pop(sid, None)
+
     def close_session(self, sid: int) -> dict:
         with self._lock:
             s = self.sessions.session(sid)
             s.pending_since = None
+            self._session_trace.pop(sid, None)
             summary = self.sessions.retire(sid)
             if sid in self._queue:
                 self._queue.remove(sid)
@@ -262,11 +278,28 @@ class PolicyService:
             t0 = self._clock()
             if rng is None:
                 rng = jax.random.fold_in(self._base_rng, self.dispatch_count)
+            # The trace_ids this wave serves (deduped, order-stable):
+            # the flight intent/seal names them so an unsealed serve
+            # intent — or the merged fleet timeline — identifies the
+            # routed requests that were on the chip.
+            wave_trace_ids = list(
+                dict.fromkeys(
+                    tid
+                    for s in served
+                    for tid in [
+                        self._session_trace.get(s.sid, {}).get("trace_id")
+                    ]
+                    if tid
+                )
+            )
             with flight_span(
                 self.flight,
                 "serve",
                 serve_program_name(self.sessions.slots),
                 avals=f"b{len(served)}",
+                trace=(
+                    {"trace_ids": wave_trace_ids} if wave_trace_ids else None
+                ),
             ):
                 # Chaos hook (docs/ROBUSTNESS.md): env-gated so an
                 # unarmed service never imports the fault module. Fires
@@ -325,19 +358,21 @@ class PolicyService:
                     s.done = True
                     self.episodes_done_total += 1
                 s.score = float(scores_np[s.slot])
-                results.append(
-                    {
-                        "sid": s.sid,
-                        "slot": s.slot,
-                        "move": s.moves,
-                        "action": int(actions[s.slot]),
-                        "reward": float(rewards_np[s.slot]),
-                        "done": done,
-                        "score": s.score,
-                        "queue_wait_ms": wait_ms,
-                        "latency_ms": lat_ms,
-                    }
-                )
+                result = {
+                    "sid": s.sid,
+                    "slot": s.slot,
+                    "move": s.moves,
+                    "action": int(actions[s.slot]),
+                    "reward": float(rewards_np[s.slot]),
+                    "done": done,
+                    "score": s.score,
+                    "queue_wait_ms": wait_ms,
+                    "latency_ms": lat_ms,
+                }
+                strace = self._session_trace.get(s.sid)
+                if strace and strace.get("trace_id"):
+                    result["trace_id"] = strace["trace_id"]
+                results.append(result)
                 self._win_wait_ms.append(wait_ms)
                 self._win_lat_ms.append(lat_ms)
             self.dispatch_count += 1

@@ -22,6 +22,11 @@ from pathlib import Path
 # to smooth chunked arrivals, short enough to track a run going sick.
 RATE_WINDOW_S = 120.0
 
+# Shed-rate window for the fleet line: short — back-pressure is a
+# now-problem, and the rate should fall back to zero quickly once the
+# brownout passes.
+FLEET_RATE_WINDOW_S = 60.0
+
 
 @dataclass
 class WatchState:
@@ -155,6 +160,131 @@ def _fmt(value: "float | None", spec: str = ",.1f", unit: str = "") -> str:
     if value is None:
         return "—"
     return f"{value:{spec}}{unit}"
+
+
+#: fleet.jsonl lifecycle events -> the replica status they imply.
+_FLEET_STATUS = {
+    "spawn": "starting",
+    "respawn": "starting",
+    "replica-ready": "up",
+    "readmit": "up",
+    "evict": "evicted",
+    "death": "down",
+    "give-up": "gone",
+}
+
+#: Router decision events worth echoing as "last decision".
+_ROUTER_EVENTS = {"shed", "retry", "exhausted", "hedge", "hedge-win"}
+
+
+@dataclass
+class FleetWatchState:
+    """Folds `fleet.jsonl` events (serving/fleet.py `_event` schema:
+    lifecycle spawns/deaths/evictions interleaved with router
+    shed/retry/hedge decisions) into the `cli watch` fleet line."""
+
+    #: replica name -> last lifecycle status (see _FLEET_STATUS).
+    replicas: dict = field(default_factory=dict)
+    #: newest router admission level (requests in flight at the router).
+    inflight: "int | None" = None
+    sheds: int = 0
+    retries: int = 0
+    exhausted: int = 0
+    hedges: int = 0
+    hedge_wins: int = 0
+    deaths: int = 0
+    #: newest router decision record, verbatim.
+    last_decision: dict = field(default_factory=dict)
+    latest_time: float = 0.0
+    _shed_times: deque = field(default_factory=lambda: deque(maxlen=2048))
+
+    def fold_fleet_line(self, line: str) -> bool:
+        """Fold one fleet.jsonl line; False for junk/torn/non-fleet
+        lines (same contract as the other folders — tolerant of
+        legacy records without trace ids)."""
+        line = line.strip()
+        if not line:
+            return False
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return False
+        if not isinstance(rec, dict) or rec.get("kind") != "fleet":
+            return False
+        event = rec.get("event")
+        t = rec.get("time")
+        if isinstance(t, (int, float)):
+            self.latest_time = max(self.latest_time, float(t))
+        name = rec.get("replica")
+        status = _FLEET_STATUS.get(event)
+        if status is not None and name:
+            self.replicas[str(name)] = status
+        if isinstance(rec.get("inflight"), int):
+            self.inflight = rec["inflight"]
+        if event == "shed":
+            self.sheds += 1
+            if isinstance(t, (int, float)):
+                self._shed_times.append(float(t))
+        elif event == "retry":
+            self.retries += 1
+        elif event == "exhausted":
+            self.exhausted += 1
+        elif event == "hedge":
+            self.hedges += 1
+        elif event == "hedge-win":
+            self.hedge_wins += 1
+        elif event == "death":
+            self.deaths += 1
+        if event in _ROUTER_EVENTS:
+            self.last_decision = rec
+        return True
+
+    @property
+    def routable(self) -> int:
+        return sum(1 for s in self.replicas.values() if s == "up")
+
+    @property
+    def shed_per_min(self) -> float:
+        """Sheds per minute over the trailing event-time window (event
+        time, not wall time — a finished ledger renders its own end)."""
+        if not self._shed_times or not self.latest_time:
+            return 0.0
+        cutoff = self.latest_time - FLEET_RATE_WINDOW_S
+        n = sum(1 for t in self._shed_times if t > cutoff)
+        return n * 60.0 / FLEET_RATE_WINDOW_S
+
+
+def fleet_line(state: FleetWatchState) -> "str | None":
+    """Render the fleet's routing vitals as watch lines: routable
+    replicas, router queue depth, windowed shed rate, and the last
+    router decision (with its trace id, the hook into `cli trace
+    --fleet`). None when no fleet events have been folded (not a
+    fleet-parent run dir)."""
+    if not state.replicas and not state.last_decision:
+        return None
+    total = len(state.replicas)
+    line = (
+        f"  fleet        {state.routable}/{total} routable"
+        f"   inflight {_fmt(state.inflight, ',.0f')}"
+        f"   sheds {state.sheds:,} ({state.shed_per_min:,.1f}/min)"
+        f"   retries {state.retries:,}"
+        f"   hedges {state.hedges:,} ({state.hedge_wins:,} won)"
+        f"   deaths {state.deaths:,}"
+    )
+    d = state.last_decision
+    if d:
+        parts = [f"last {d.get('event')}"]
+        if d.get("rejection"):
+            parts.append(str(d["rejection"]))
+        if d.get("replica"):
+            parts.append(f"-> {d['replica']}")
+        if isinstance(d.get("attempt"), int):
+            parts.append(f"attempt {d['attempt']}")
+        tid = d.get("trace_id")
+        if isinstance(tid, str) and tid:
+            parts.append(f"trace {tid[:8]}…")
+        line += "\n  router       " + " ".join(parts)
+    return line
 
 
 def health_line(health: "dict | None", now: "float | None" = None) -> "str | None":
@@ -354,6 +484,15 @@ def tail_flight(
 ) -> int:
     """Fold `flight.jsonl` dispatch records appended past `offset`."""
     return tail_jsonl(path, state.fold_flight_line, offset)
+
+
+def tail_fleet(
+    path: Path,
+    state: FleetWatchState,
+    offset: int = 0,
+) -> int:
+    """Fold `fleet.jsonl` events appended past `offset`."""
+    return tail_jsonl(path, state.fold_fleet_line, offset)
 
 
 def find_latest_run_dir(runs_root: Path) -> "Path | None":

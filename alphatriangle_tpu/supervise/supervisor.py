@@ -43,6 +43,7 @@ from ..telemetry.flight import (
     read_preempt_report,
     read_wedge_report,
 )
+from ..telemetry import tracectx
 from ..telemetry.ledger import MetricsLedger, read_ledger, resolve_ledger_path
 from .policy import Action, RecoveryPolicy
 
@@ -149,18 +150,27 @@ class Supervisor:
         self._now = now
         self.run_dir.mkdir(parents=True, exist_ok=True)
         self._ledger = MetricsLedger(self.run_dir / SUPERVISOR_FILENAME)
+        # Supervision-lifetime root trace (telemetry/tracectx.py):
+        # every attempt gets a child context, stamped on its
+        # supervisor.jsonl events and handed to the child via the
+        # traceparent env seam (its flight ring adopts it), so one
+        # trace_id links a spawn to everything that attempt dispatched.
+        self.trace_ctx = tracectx.mint(parent=tracectx.from_env())
+        self._attempt_ctx: "tracectx.TraceContext | None" = None
         self._child = None
         self._terminating = False
 
     # --- events -----------------------------------------------------------
 
     def _event(self, event: str, **fields) -> None:
+        ctx = self._attempt_ctx or self.trace_ctx
         self._ledger.append(
             {
                 "kind": "supervisor",
                 "event": event,
                 "time": self._now(),
                 "pid": os.getpid(),
+                **ctx.fields(),
                 **fields,
             }
         )
@@ -210,7 +220,8 @@ class Supervisor:
         try:
             while True:
                 attempt += 1
-                env = dict(os.environ)
+                self._attempt_ctx = self.trace_ctx.child()
+                env = tracectx.child_env(self._attempt_ctx)
                 if overrides:
                     env[OVERRIDES_ENV] = json.dumps(overrides)
                 spawn_t = self._now()

@@ -73,8 +73,18 @@ from .memory import (
     train_state_record,
     tree_bytes,
 )
+from .merge import MERGED_TRACE_FILENAME, merge_fleet_trace
 from .perf import UtilizationMeter, summarize_utilization
+from .slo import (
+    FLEET_PROM_FILENAME,
+    SLO_EXIT_CODES,
+    evaluate_slos,
+    slo_status_line,
+    write_fleet_prometheus,
+)
+from .tracectx import TraceContext, TRACEPARENT_ENV
 from .tracer import SpanTracer, summarize_trace_file
+from . import tracectx
 
 logger = logging.getLogger(__name__)
 
@@ -83,11 +93,17 @@ __all__ = [
     "AnomalyDetector",
     "DispatchWatchdog",
     "FlightRecorder",
+    "FLEET_PROM_FILENAME",
     "HealthMonitor",
+    "MERGED_TRACE_FILENAME",
     "MetricsLedger",
+    "SLO_EXIT_CODES",
     "RunTelemetry",
     "SpanTracer",
     "TelemetryConfig",
+    "TraceContext",
+    "TRACEPARENT_ENV",
+    "tracectx",
     "UtilizationMeter",
     "Watchdog",
     "attribution_rows",
@@ -98,7 +114,11 @@ __all__ = [
     "compose_budget",
     "dump_thread_stacks",
     "estimate_fit",
+    "evaluate_slos",
     "fit_verdict",
+    "merge_fleet_trace",
+    "slo_status_line",
+    "write_fleet_prometheus",
     "health_verdict",
     "program_memory_record",
     "read_health",
@@ -200,6 +220,11 @@ class RunTelemetry:
                     exit_on_wedge=self.config.DISPATCH_EXIT_ON_WEDGE,
                     clock=clock,
                 )
+            # A parent (supervisor attempt / fleet spawn) may have
+            # handed this process a trace context via the traceparent
+            # env seam; adopting it as the ring's base trace links
+            # every dispatch here back to the spawning attempt.
+            parent_ctx = tracectx.from_env()
             self.flight = FlightRecorder(
                 self.run_dir / FLIGHT_FILENAME,
                 max_bytes=self.config.FLIGHT_MAX_BYTES,
@@ -208,6 +233,9 @@ class RunTelemetry:
                 min_deadline_s=self.config.DISPATCH_MIN_DEADLINE_S,
                 first_deadline_s=self.config.DISPATCH_FIRST_DEADLINE_S,
                 watchdog=self.dispatch_watchdog,
+                base_trace=(
+                    parent_ctx.fields() if parent_ctx is not None else None
+                ),
             )
         self._step = 0
         self._memory_seen: set = set()

@@ -106,14 +106,25 @@ def program_family(program: str) -> str:
 class FlightSpan:
     """One armed dispatch: seal exactly once (idempotent)."""
 
-    __slots__ = ("recorder", "seq", "program", "family", "t0", "_sealed")
+    __slots__ = (
+        "recorder", "seq", "program", "family", "t0", "trace", "_sealed",
+    )
 
-    def __init__(self, recorder, seq: int, program: str, family: str, t0: float):
+    def __init__(
+        self,
+        recorder,
+        seq: int,
+        program: str,
+        family: str,
+        t0: float,
+        trace: "dict | None" = None,
+    ):
         self.recorder = recorder
         self.seq = seq
         self.program = program
         self.family = family
         self.t0 = t0
+        self.trace = trace
         self._sealed = False
 
     def seal(self, error: "str | None" = None) -> None:
@@ -142,8 +153,14 @@ class FlightRecorder:
         min_deadline_s: float = 60.0,
         first_deadline_s: float = 900.0,
         watchdog: "DispatchWatchdog | None" = None,
+        base_trace: "dict | None" = None,
     ) -> None:
         self.path = Path(path)
+        # Default trace fields for every bracket that doesn't pass its
+        # own: RunTelemetry sets this from the env seam so a spawned
+        # child's dispatches link back to the supervisor attempt that
+        # spawned it (telemetry/tracectx.py).
+        self.base_trace = dict(base_trace) if base_trace else None
         self._ledger = MetricsLedger(self.path, max_bytes=max_bytes, keep=keep)
         self.deadline_factor = deadline_factor
         self.min_deadline_s = min_deadline_s
@@ -185,33 +202,45 @@ class FlightRecorder:
         return max(self.min_deadline_s, self.deadline_factor * expected)
 
     def begin(
-        self, family: str, program: str, avals: "str | None" = None
+        self,
+        family: str,
+        program: str,
+        avals: "str | None" = None,
+        trace: "dict | None" = None,
     ) -> FlightSpan:
         """Write the intent record and arm the watchdog; call BEFORE
-        the dispatch. Returns the span to `seal()` after the fetch."""
+        the dispatch. Returns the span to `seal()` after the fetch.
+
+        `trace` is an optional dict of trace-context fields
+        (trace_id/span_id/... or trace_ids for a batched wave) merged
+        into BOTH the intent and the seal record, so an unsealed
+        intent names not just the hung program but the exact request(s)
+        it was serving (telemetry/tracectx.py)."""
         t_host = time.perf_counter()
         with self._lock:
             self._seq += 1
             seq = self._seq
             expected = self._expected.get(program)
         deadline = self.deadline_s(expected)
-        self._ledger.append(
-            {
-                "kind": "flight",
-                "phase": "intent",
-                "seq": seq,
-                "program": program,
-                "family": family,
-                "avals": avals,
-                "expected_s": (
-                    round(expected, 6) if expected is not None else None
-                ),
-                "deadline_s": round(deadline, 3),
-                "t_mono": time.monotonic(),
-                "time": time.time(),
-                "pid": os.getpid(),
-            }
-        )
+        trace = trace if trace else self.base_trace
+        record = {
+            "kind": "flight",
+            "phase": "intent",
+            "seq": seq,
+            "program": program,
+            "family": family,
+            "avals": avals,
+            "expected_s": (
+                round(expected, 6) if expected is not None else None
+            ),
+            "deadline_s": round(deadline, 3),
+            "t_mono": time.monotonic(),
+            "time": time.time(),
+            "pid": os.getpid(),
+        }
+        if trace:
+            record.update(trace)
+        self._ledger.append(record)
         if self.watchdog is not None:
             self.watchdog.arm(
                 seq,
@@ -228,7 +257,9 @@ class FlightRecorder:
             from ..supervise.faults import fault_point
 
             fault_point("dispatch", seq, flight_path=self.path)
-        span = FlightSpan(self, seq, program, family, time.perf_counter())
+        span = FlightSpan(
+            self, seq, program, family, time.perf_counter(), trace=trace
+        )
         self.overhead_seconds += span.t0 - t_host
         return span
 
@@ -248,6 +279,8 @@ class FlightRecorder:
             "t_mono": time.monotonic(),
             "time": time.time(),
         }
+        if span.trace:
+            record.update(span.trace)
         if error is not None:
             record["error"] = error
         self._ledger.append(record)
@@ -279,16 +312,18 @@ def flight_span(
     family: str,
     program: str,
     avals: "str | None" = None,
+    trace: "dict | None" = None,
 ):
     """Intent/seal bracket for a synchronous dispatch site; a no-op
     when the component has no recorder attached (tests, telemetry
     disabled). A raising dispatch seals `ok: false` with the error —
     an *unsealed* intent therefore always means the process died or
-    wedged inside the bracket."""
+    wedged inside the bracket. `trace` rides through to both the
+    intent and the seal (see `FlightRecorder.begin`)."""
     if recorder is None:
         yield None
         return
-    span = recorder.begin(family, program, avals=avals)
+    span = recorder.begin(family, program, avals=avals, trace=trace)
     try:
         yield span
     except BaseException as exc:

@@ -508,6 +508,23 @@ def summarize_fleet(records: list) -> "dict | None":
         "fleet_evictions": count("evict"),
         "fleet_readmissions": count("readmit"),
         "fleet_sheds": count("shed"),
+        # Rejection codes kept distinct (serving/router.py REJECT_*):
+        # queue-full is admission back-pressure, no-healthy-replica is
+        # a fleet outage, retries-exhausted is a replica sickness —
+        # one folded shed total hides which one is burning the budget.
+        "fleet_shed_queue_full": sum(
+            1
+            for r in events
+            if r.get("event") == "shed"
+            and r.get("rejection") == "queue-full"
+        ),
+        "fleet_shed_no_healthy": sum(
+            1
+            for r in events
+            if r.get("event") == "shed"
+            and r.get("rejection") == "no-healthy-replica"
+        ),
+        "fleet_shed_retries_exhausted": count("exhausted"),
         "fleet_retries": count("retry"),
         "fleet_hedges": count("hedge"),
         "fleet_hedge_wins": count("hedge-win"),

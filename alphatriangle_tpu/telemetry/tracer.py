@@ -69,6 +69,25 @@ class SpanTracer:
                 )
                 self.recorded += 1
 
+    def complete(
+        self, name: str, begin_ns: int, end_ns: int, **args
+    ) -> None:
+        """Record a complete span from explicit wall timestamps — for
+        spans whose begin was captured earlier than the code that
+        finishes them (e.g. a serve replica records the whole episode
+        span at finish, begin captured at request arrival). Duration is
+        clamped non-negative so a torn clock can't corrupt the trace."""
+        if not self.enabled:
+            return
+        thread = threading.current_thread()
+        with self._lock:
+            self._spans.append(
+                (_COMPLETE, name, int(begin_ns),
+                 max(0, int(end_ns) - int(begin_ns)), thread.ident,
+                 thread.name, args or None)
+            )
+            self.recorded += 1
+
     def instant(self, name: str, **args) -> None:
         """Record a zero-duration marker (e.g. a watchdog stall)."""
         if not self.enabled:

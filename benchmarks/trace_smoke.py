@@ -1,0 +1,494 @@
+"""CI distributed-tracing gate: one causal timeline, router to chip.
+
+`make trace-smoke` runs this. On a CPU-only box it proves the
+cross-process tracing + SLO contract (docs/OBSERVABILITY.md
+"Distributed tracing & SLOs") end to end:
+
+1. the storm: `cli fleet` drives episode requests through 2 replicas
+   with an aggressive hedge trigger while a `hang-serve` fault wedges
+   one replica mid-storm — so the ledger is guaranteed to hold BOTH
+   router recovery paths: hedged dispatches (slow primary, hedge fired)
+   and retried dispatches (dead primary, rerouted), each stamped with
+   its request's trace_id;
+2. the merge: `cli trace --fleet` (run under the same jax import
+   guard as the fleet parent — the merge is a reader for dead fleets)
+   fuses fleet.jsonl + the parent's route brackets + every replica's
+   flight ring and trace.json into trace_fleet.json; the merged trace
+   must contain flow arrows for >= 1 hedged and >= 1 retried request,
+   and every flow's trace_id must appear consistently in fleet.jsonl,
+   in a replica flight ring, and in the merged trace;
+3. the SLO exit-code contract: `cli slo` (jax-guarded) returns 0 on a
+   healthy window, 1 on a brownout window burning its availability
+   budget, 2 on a run dir with no data — pinned against synthetic
+   ledgers so the contract can't drift with storm noise.
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPLICAS = 2
+SLOTS = 8
+REQUESTS = 64
+MAX_MOVES = 6
+#: Aggressive hedge trigger: any dispatch slower than this (queue wait
+#: behind the wedged replica, compile warm-up stragglers) hedges onto
+#: the peer — guaranteeing hedge/hedge-win events in the ledger.
+HEDGE_AFTER_S = 0.3
+#: The wedge: hang-serve freezes the first replica to reach this many
+#: dispatches. Requests queued behind the frozen dispatch wait past
+#: the hedge trigger long before the watchdog's ~2s deadline fires —
+#: the wedge GUARANTEES hedges.
+HANG_AFTER_DISPATCH = 8
+#: Mid-storm SIGKILL: the victim's in-flight requests EOF instantly —
+#: faster than the hedge trigger — so they fail outright and get
+#: RETRIED onto the peer. (A wedge alone can't pin retries: its
+#: requests hedge at 0.3s and complete via hedge-win, never retrying.)
+KILL_AFTER = 32
+
+# Same import-guard preamble as fleet_smoke.py: any jax import in the
+# guarded subprocess raises. The whole observability readout — fleet
+# parent, merge, slo — must work beside a dead or wedged accelerator.
+_NO_JAX_PREAMBLE = (
+    "import builtins, sys;"
+    "_real = builtins.__import__;\n"
+    "def _guard(name, *a, **k):\n"
+    "    if name == 'jax' or name.startswith('jax.'):\n"
+    "        raise ImportError('tracing readers must not import jax: ' + name)\n"
+    "    return _real(name, *a, **k)\n"
+    "builtins.__import__ = _guard\n"
+)
+
+
+def tiny_configs():
+    """fleet_smoke's tiny board/net (fast compile, fast moves)."""
+    from alphatriangle_tpu.config import (
+        EnvConfig,
+        ModelConfig,
+        expected_other_features_dim,
+    )
+
+    env_cfg = EnvConfig(
+        ROWS=3,
+        COLS=4,
+        PLAYABLE_RANGE_PER_ROW=[(0, 4), (0, 4), (0, 4)],
+        NUM_SHAPE_SLOTS=1,
+        MAX_SHAPE_TRIANGLES=3,
+        LINE_MIN_LENGTH=3,
+    )
+    model_cfg = ModelConfig(
+        GRID_INPUT_CHANNELS=1,
+        CONV_FILTERS=[4],
+        CONV_KERNEL_SIZES=[3],
+        CONV_STRIDES=[1],
+        NUM_RESIDUAL_BLOCKS=0,
+        RESIDUAL_BLOCK_FILTERS=4,
+        USE_TRANSFORMER=False,
+        FC_DIMS_SHARED=[16],
+        POLICY_HEAD_DIMS=[16],
+        VALUE_HEAD_DIMS=[16],
+        OTHER_NN_INPUT_FEATURES_DIM=expected_other_features_dim(env_cfg),
+        NUM_VALUE_ATOMS=11,
+        COMPUTE_DTYPE="float32",
+    )
+    return env_cfg, model_cfg
+
+
+def run_dir_for(root: str, run_name: str) -> Path:
+    from alphatriangle_tpu.config import PersistenceConfig
+
+    return PersistenceConfig(
+        ROOT_DATA_DIR=root, RUN_NAME=run_name
+    ).get_run_base_dir()
+
+
+def fleet_events(ledger: Path) -> list:
+    events = []
+    if not ledger.exists():
+        return events
+    for line in ledger.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "fleet":
+            events.append(rec)
+    return events
+
+
+def _guarded_cli(argv: list, timeout: float = 300.0):
+    """Run `cli <argv>` in a jax-import-guarded subprocess."""
+    code = (
+        _NO_JAX_PREAMBLE
+        + "from alphatriangle_tpu.cli import main\n"
+        + f"sys.exit(main({argv!r}))\n"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=str(REPO),
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def _fail(msg: str) -> int:
+    print(f"trace-smoke: {msg}", file=sys.stderr)
+    return 2
+
+
+class _ArmedFaults:
+    def __init__(self, spec: str, state_dir: Path) -> None:
+        self.spec = spec
+        self.state_dir = state_dir
+
+    def __enter__(self):
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        os.environ["ALPHATRIANGLE_FAULTS"] = self.spec
+        os.environ["ALPHATRIANGLE_FAULT_STATE_DIR"] = str(self.state_dir)
+        return self
+
+    def __exit__(self, *exc):
+        os.environ.pop("ALPHATRIANGLE_FAULTS", None)
+        os.environ.pop("ALPHATRIANGLE_FAULT_STATE_DIR", None)
+        return False
+
+
+def stage_storm(root: Path) -> "tuple[int, Path]":
+    """2-replica storm with a mid-storm wedge: hedges fire off the
+    aggressive trigger, retries off the wedge death."""
+    run = "trace_smoke"
+    run_dir = run_dir_for(str(root), run)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    env_cfg, model_cfg = tiny_configs()
+    (run_dir / "configs.json").write_text(
+        json.dumps(
+            {"env": env_cfg.model_dump(), "model": model_cfg.model_dump()}
+        )
+    )
+    argv = [
+        "fleet",
+        "--smoke",
+        "--run-name",
+        run,
+        "--root-dir",
+        str(root),
+        "--replicas",
+        str(REPLICAS),
+        "--slots",
+        str(SLOTS),
+        "--sims",
+        "2",
+        "--requests",
+        str(REQUESTS),
+        "--concurrency",
+        "8",
+        "--max-moves",
+        str(MAX_MOVES),
+        "--timeout",
+        "60",
+        "--retries",
+        "2",
+        "--route-backoff-base",
+        "0.1",
+        "--route-backoff-max",
+        "1.0",
+        "--hedge-after",
+        str(HEDGE_AFTER_S),
+        "--max-queue",
+        "64",
+        "--probe-deadline",
+        "10",
+        "--poll",
+        "0.25",
+        "--settle",
+        "90",
+        "--backoff-base",
+        "0.5",
+        "--backoff-max",
+        "4.0",
+        "--quarantine-after",
+        "1",
+        "--max-restarts",
+        "8",
+        "--circuit-breaker",
+        "6",
+        "--replica-health-interval",
+        "1.0",
+        "--replica-dispatch-min-deadline",
+        "2.0",
+        "--replica-dispatch-first-deadline",
+        "120",
+        "--replica-watchdog-poll",
+        "0.25",
+        "--tick-every",
+        "4",
+        "--chaos-kill-after",
+        str(KILL_AFTER),
+    ]
+    with _ArmedFaults(
+        f"hang-serve@after={HANG_AFTER_DISPATCH}", root / "faults_trace"
+    ):
+        proc = _guarded_cli(argv, timeout=900.0)
+    report = None
+    for line in proc.stdout.splitlines():
+        if line.strip().startswith("{"):
+            try:
+                report = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    if proc.returncode != 0 or report is None:
+        tail = "\n".join(proc.stderr.splitlines()[-30:])
+        return (
+            _fail(
+                f"cli fleet failed (rc={proc.returncode}, "
+                f"report={'yes' if report else 'no'})\nstderr tail:\n{tail}"
+            ),
+            run_dir,
+        )
+    if report["lost"] != 0 or report["completed"] <= 0:
+        return _fail(f"storm accounting broke: {report}"), run_dir
+
+    events = fleet_events(run_dir / "fleet.jsonl")
+    hedged = [e for e in events if e.get("event") == "hedge"]
+    retried = [e for e in events if e.get("event") == "retry"]
+    if not hedged:
+        return _fail("no hedge events — hedge trigger never fired"), run_dir
+    if not retried:
+        return _fail("no retry events — the wedge never forced a reroute"), run_dir
+    untraced = [
+        e for e in hedged + retried if not e.get("trace_id")
+    ]
+    if untraced:
+        return _fail(f"router decisions without trace_id: {untraced[:3]}"), run_dir
+    print(
+        f"trace-smoke: storm ok — {report['completed']}/{report['requests']} "
+        f"served, {len(hedged)} hedges, {len(retried)} retries, "
+        f"slo={report.get('slo')}"
+    )
+    return 0, run_dir
+
+
+def stage_merge(root: Path, run_dir: Path) -> int:
+    """`cli trace --fleet` under the jax guard; the merged trace must
+    hold flow arrows for >= 1 hedged and >= 1 retried trace_id, with
+    ids consistent across fleet.jsonl, the replica flight rings, and
+    the merged trace itself."""
+    proc = _guarded_cli(
+        ["trace", run_dir.name, "--fleet", "--root-dir", str(root)],
+        timeout=300.0,
+    )
+    if proc.returncode != 0:
+        return _fail(
+            f"cli trace --fleet failed (rc={proc.returncode})\n"
+            f"stderr: {proc.stderr[-2000:]}"
+        )
+    merged_path = run_dir / "trace_fleet.json"
+    if not merged_path.exists():
+        return _fail(f"{merged_path} not written")
+    payload = json.loads(merged_path.read_text())
+    trace_events = payload.get("traceEvents", [])
+    flow_ids = {
+        e.get("id")
+        for e in trace_events
+        if e.get("cat") == "fleet-flow" and e.get("ph") in ("s", "t", "f")
+    }
+    if not flow_ids:
+        return _fail("merged trace holds no flow arrows")
+    # Causal order: within each flow id, the step timestamps must be
+    # non-decreasing, and no merged span may have a negative duration.
+    by_id: dict = {}
+    for e in trace_events:
+        if e.get("cat") == "fleet-flow":
+            by_id.setdefault(e["id"], []).append(e)
+        if e.get("ph") == "X" and (e.get("dur") or 0) < 0:
+            return _fail(f"negative-duration span in merged trace: {e}")
+    for fid, steps in by_id.items():
+        ts = [s["ts"] for s in sorted(steps, key=lambda s: s["ts"])]
+        if ts != sorted(ts):
+            return _fail(f"flow {fid} steps out of causal order: {ts}")
+
+    events = fleet_events(run_dir / "fleet.jsonl")
+    hedged_ids = {
+        e["trace_id"] for e in events
+        if e.get("event") == "hedge" and e.get("trace_id")
+    }
+    retried_ids = {
+        e["trace_id"] for e in events
+        if e.get("event") == "retry" and e.get("trace_id")
+    }
+    if not (hedged_ids & flow_ids):
+        return _fail(
+            f"no hedged request has a flow arrow "
+            f"(hedged={len(hedged_ids)}, flows={len(flow_ids)})"
+        )
+    if not (retried_ids & flow_ids):
+        return _fail(
+            f"no retried request has a flow arrow "
+            f"(retried={len(retried_ids)}, flows={len(flow_ids)})"
+        )
+    # Consistency: each checked trace_id must also appear in at least
+    # one replica flight ring (the chip end of the causal chain).
+    ring_text = ""
+    for rdir in sorted(run_dir.glob("replica_*")):
+        ring = rdir / "flight.jsonl"
+        if ring.exists():
+            ring_text += ring.read_text()
+    for tid in list(hedged_ids & flow_ids)[:1] + list(retried_ids & flow_ids)[:1]:
+        if tid not in ring_text:
+            return _fail(f"trace_id {tid} missing from replica flight rings")
+    print(
+        f"trace-smoke: merge ok — {len(flow_ids)} flow trace ids, "
+        f"hedged+retried both causally linked router->replica"
+    )
+    return 0
+
+
+def _write_slo_fixture(run_dir: Path, *, sheds: int) -> None:
+    """Synthetic fleet run dir: 100 served requests over 60s, p95 well
+    under threshold, ok dispatch seals — plus `sheds` availability
+    failures. sheds=0 is a healthy window; sheds=50 burns the 1%
+    availability budget at x~33 (>= both default thresholds)."""
+    now = time.time()
+    run_dir.mkdir(parents=True, exist_ok=True)
+    with (run_dir / "metrics.jsonl").open("w") as f:
+        for i in range(6):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "util",
+                        "time": now - 50 + i * 10,
+                        "step": i,
+                        "window_s": 10.0,
+                        "serve_requests_per_sec": 100.0 / 60.0,
+                    }
+                )
+                + "\n"
+            )
+    with (run_dir / "fleet.jsonl").open("w") as f:
+        f.write(
+            json.dumps(
+                {"kind": "fleet", "event": "fleet-start", "time": now - 55}
+            )
+            + "\n"
+        )
+        for i in range(sheds):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "fleet",
+                        "event": "shed",
+                        "rejection": "queue-full",
+                        "time": now - 40 + (i % 30),
+                    }
+                )
+                + "\n"
+            )
+        f.write(
+            json.dumps(
+                {"kind": "fleet", "event": "fleet-stop", "time": now}
+            )
+            + "\n"
+        )
+    rdir = run_dir / "replica_r0"
+    rdir.mkdir(exist_ok=True)
+    with (rdir / "metrics.jsonl").open("w") as f:
+        for i in range(6):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "util",
+                        "time": now - 50 + i * 10,
+                        "step": i,
+                        "window_s": 10.0,
+                        "serve_move_latency_ms_p95": 20.0,
+                        "serve_window_requests": 16,
+                    }
+                )
+                + "\n"
+            )
+    with (rdir / "flight.jsonl").open("w") as f:
+        for i in range(10):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "flight",
+                        "phase": "seal",
+                        "family": "serve",
+                        "program": "serve/b8",
+                        "seq": i,
+                        "ok": True,
+                        "time": now - 45 + i * 4,
+                    }
+                )
+                + "\n"
+            )
+
+
+def stage_slo_contract(root: Path) -> int:
+    """`cli slo` exit codes, pinned: healthy -> 0, brownout -> 1,
+    no data -> 2 (all jax-guarded)."""
+    healthy = root / "slo_healthy"
+    brownout = root / "slo_brownout"
+    empty = root / "slo_empty"
+    _write_slo_fixture(healthy, sheds=0)
+    _write_slo_fixture(brownout, sheds=50)
+    empty.mkdir(parents=True, exist_ok=True)
+    for run_dir, want in ((healthy, 0), (brownout, 1), (empty, 2)):
+        proc = _guarded_cli(["slo", str(run_dir), "--json"], timeout=120.0)
+        if proc.returncode != want:
+            return _fail(
+                f"cli slo {run_dir.name}: exit {proc.returncode}, "
+                f"want {want}\nstdout: {proc.stdout[-1500:]}\n"
+                f"stderr: {proc.stderr[-500:]}"
+            )
+        if want != 2:
+            report = json.loads(proc.stdout.strip().splitlines()[-1])
+            if report["schema"] != "alphatriangle.slo.v1":
+                return _fail(f"bad slo schema: {report['schema']}")
+    print("trace-smoke: slo exit contract ok (healthy=0 brownout=1 empty=2)")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root-dir", default=None)
+    args = parser.parse_args()
+
+    root = Path(args.root_dir or tempfile.mkdtemp(prefix="at_trace_smoke_"))
+    t0 = time.monotonic()
+    try:
+        rc, run_dir = stage_storm(root)
+        if rc != 0:
+            return rc
+        rc = stage_merge(root, run_dir)
+        if rc != 0:
+            return rc
+        rc = stage_slo_contract(root)
+        if rc != 0:
+            return rc
+    finally:
+        if args.root_dir is None:
+            shutil.rmtree(root, ignore_errors=True)
+    print(f"trace-smoke: OK ({time.monotonic() - t0:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

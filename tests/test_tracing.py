@@ -1,0 +1,712 @@
+"""Cross-process distributed tracing + fleet SLO engine
+(alphatriangle_tpu/telemetry/tracectx.py, merge.py, slo.py;
+docs/OBSERVABILITY.md "Distributed tracing & SLOs").
+
+Covers the context seam (mint/child/traceparent/env round trips with
+legacy id-less tolerance), router trace propagation through fake
+replicas, flight-ring trace stamping, the Perfetto fleet merge under
+DELIBERATE clock skew (two replicas with offset monotonic epochs must
+still produce causally ordered flow arrows and zero negative-duration
+spans), the `cli slo` exit-code contract, the fleet Prometheus
+aggregation, and the fleet-parent doctor verdicts. JAX never loads on
+any of these paths — every reader runs beside a dead fleet.
+"""
+
+import json
+import os
+
+from alphatriangle_tpu.serving.fleet import classify_fleet
+from alphatriangle_tpu.serving.router import (
+    REJECT_QUEUE_FULL,
+    ReplicaRouter,
+)
+from alphatriangle_tpu.stats.watch import (
+    FleetWatchState,
+    fleet_line,
+    tail_fleet,
+)
+from alphatriangle_tpu.telemetry import tracectx
+from alphatriangle_tpu.telemetry.flight import FlightRecorder, flight_span
+from alphatriangle_tpu.telemetry.merge import (
+    FLOW_CAT,
+    MERGED_TRACE_FILENAME,
+    merge_fleet_trace,
+)
+from alphatriangle_tpu.telemetry.slo import (
+    SLO_EXIT_CODES,
+    evaluate_slos,
+    slo_status_line,
+    write_fleet_prometheus,
+)
+from alphatriangle_tpu.telemetry.tracectx import (
+    TRACEPARENT_ENV,
+    TraceContext,
+)
+
+# --- trace context -------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_mint_and_child_share_the_trace(self):
+        root = tracectx.mint()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.span_id != root.span_id
+        assert child.parent_id == root.span_id
+
+    def test_traceparent_round_trip(self):
+        ctx = tracectx.mint()
+        parsed = TraceContext.from_traceparent(ctx.to_traceparent())
+        assert parsed is not None
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+
+    def test_malformed_traceparent_is_none_not_a_crash(self):
+        for junk in ("", "garbage", "00-zz-xx-01", "99-" + "a" * 32, None):
+            assert TraceContext.from_traceparent(junk) is None
+
+    def test_env_seam_round_trip(self):
+        ctx = tracectx.mint()
+        env = tracectx.child_env(ctx, environ={})
+        assert TRACEPARENT_ENV in env
+        back = tracectx.from_env(environ=env)
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        # child_env(None) POPS the var: a child spawned outside any
+        # trace must not inherit a stale one.
+        cleared = tracectx.child_env(None, environ=env)
+        assert TRACEPARENT_ENV not in cleared
+
+    def test_from_fields_tolerates_legacy_records(self):
+        # Pre-tracing records carry no ids at all.
+        assert TraceContext.from_fields({}) is None
+        assert TraceContext.from_fields({"event": "shed"}) is None
+        # trace_id without span_id (partial legacy) gets a fresh span.
+        ctx = TraceContext.from_fields({"trace_id": "a" * 32})
+        assert ctx is not None and ctx.span_id
+
+    def test_fields_and_trace_fields_extraction(self):
+        ctx = tracectx.mint().child()
+        record = {"event": "retry", "replica": "r0", **ctx.fields()}
+        extracted = tracectx.trace_fields(record)
+        assert extracted["trace_id"] == ctx.trace_id
+        assert extracted["parent_id"] == ctx.parent_id
+        assert "event" not in extracted
+        assert tracectx.trace_fields({"event": "retry"}) == {}
+
+
+# --- router propagation --------------------------------------------------
+
+
+class _Pending:
+    def __init__(self, value):
+        self.value = value
+        self.error = None
+
+    def done(self):
+        return True
+
+    def wait(self, timeout=None):
+        return True
+
+    def cancel(self):
+        pass
+
+
+class _Replica:
+    routable = True
+    queue_depth = 0
+    bucket = 8
+
+    def __init__(self, name):
+        self.name = name
+        self.submits = []
+
+    def submit(self, payload):
+        self.submits.append(payload)
+        return _Pending({"ok": True})
+
+
+class TestRouterTracing:
+    def test_route_mints_and_propagates_a_context(self):
+        events = []
+        replica = _Replica("r0")
+        router = ReplicaRouter(
+            [replica], timeout_s=5.0, retries=0, on_event=events.append
+        )
+        result = router.route({"kind": "episode", "seed": 0})
+        assert result.ok and result.trace_id
+        # The replica-bound payload carries the request's trace fields.
+        sent = replica.submits[0]
+        assert sent["trace_id"] == result.trace_id
+        assert sent["span_id"]
+
+    def test_route_continues_a_caller_context(self):
+        parent = tracectx.mint()
+        replica = _Replica("r0")
+        router = ReplicaRouter([replica], timeout_s=5.0, retries=0)
+        result = router.route(
+            {"kind": "episode", "seed": 0, **parent.fields()}
+        )
+        assert result.trace_id == parent.trace_id
+        # but with a fresh per-request span under the caller's.
+        assert replica.submits[0]["span_id"] != parent.span_id
+
+    def test_queue_full_shed_carries_the_trace_id(self):
+        events = []
+        router = ReplicaRouter(
+            [_Replica("r0")],
+            timeout_s=5.0,
+            retries=0,
+            max_inflight=0,
+            on_event=events.append,
+        )
+        result = router.route({"kind": "episode"})
+        assert not result.ok and result.rejection == REJECT_QUEUE_FULL
+        assert result.trace_id
+        shed = [e for e in events if e["event"] == "shed"][0]
+        assert shed["trace_id"] == result.trace_id
+        assert isinstance(shed["inflight"], int)
+
+
+# --- flight-ring stamping ------------------------------------------------
+
+
+class TestFlightTracing:
+    def test_trace_fields_land_on_intent_and_seal(self, tmp_path):
+        rec = FlightRecorder(tmp_path / "flight.jsonl")
+        ctx = tracectx.mint()
+        with flight_span(rec, "serve", "serve/b8", trace=ctx.fields()):
+            pass
+        rec.close()
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "flight.jsonl").read_text().splitlines()
+        ]
+        intent = [r for r in records if r.get("phase") == "intent"][0]
+        seal = [r for r in records if r.get("phase") == "seal"][0]
+        assert intent["trace_id"] == ctx.trace_id
+        assert seal["trace_id"] == ctx.trace_id
+
+    def test_base_trace_is_the_env_seam_default(self, tmp_path):
+        parent = tracectx.mint().fields()
+        rec = FlightRecorder(tmp_path / "flight.jsonl", base_trace=parent)
+        with flight_span(rec, "train", "train/step"):
+            pass
+        rec.close()
+        intent = json.loads(
+            (tmp_path / "flight.jsonl").read_text().splitlines()[0]
+        )
+        assert intent["trace_id"] == parent["trace_id"]
+
+
+# --- merge under clock skew ----------------------------------------------
+
+WALL = 1_700_000_000.0
+PARENT_PID, R0_PID, R1_PID = 100, 200, 300
+# Deliberately skewed monotonic epochs: replica r0 booted "recently"
+# (small monotonic), r1 has a huge uptime — naive mono comparison
+# across processes would be wildly acausal.
+MONO_EPOCH = {PARENT_PID: 1_000.0, R0_PID: 50.0, R1_PID: 90_000.0}
+
+
+def _mono(pid: int, wall_offset: float) -> float:
+    return MONO_EPOCH[pid] + wall_offset
+
+
+def _jl(path, records):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _skewed_fleet_dir(tmp_path, trace_ids=("t" * 31 + "1", "t" * 31 + "2")):
+    """Fleet-parent run dir with two fake replicas on offset monotonic
+    clocks: request 0 served by r0 at wall+1..+2, request 1 by r1 at
+    wall+3..+4, each route bracket opening slightly earlier."""
+    t1, t2 = trace_ids
+    run = tmp_path / "run"
+    _jl(
+        run / "flight.jsonl",
+        [
+            {
+                "kind": "flight", "phase": "intent", "seq": 1,
+                "program": "fleet/route", "family": "fleet",
+                "pid": PARENT_PID, "trace_id": t1,
+                "t_mono": _mono(PARENT_PID, 0.5), "time": WALL + 0.5,
+            },
+            {
+                "kind": "flight", "phase": "seal", "seq": 1,
+                "program": "fleet/route", "family": "fleet", "ok": True,
+                "trace_id": t1, "wall_s": 1.7,
+                "t_mono": _mono(PARENT_PID, 2.2), "time": WALL + 2.2,
+            },
+            {
+                "kind": "flight", "phase": "intent", "seq": 2,
+                "program": "fleet/route", "family": "fleet",
+                "pid": PARENT_PID, "trace_id": t2,
+                "t_mono": _mono(PARENT_PID, 2.5), "time": WALL + 2.5,
+            },
+            {
+                "kind": "flight", "phase": "seal", "seq": 2,
+                "program": "fleet/route", "family": "fleet", "ok": True,
+                "trace_id": t2, "wall_s": 1.8,
+                "t_mono": _mono(PARENT_PID, 4.3), "time": WALL + 4.3,
+            },
+        ],
+    )
+    _jl(
+        run / "fleet.jsonl",
+        [
+            {
+                "kind": "fleet", "event": "fleet-start",
+                "time": WALL, "pid": PARENT_PID,
+            },
+            {
+                "kind": "fleet", "event": "replica-ready",
+                "replica": "r0", "replica_pid": R0_PID,
+                "t_mono": _mono(R0_PID, 0.2),
+                "replica_time": WALL + 0.2,
+                "time": WALL + 0.2, "pid": PARENT_PID,
+            },
+            {
+                "kind": "fleet", "event": "replica-ready",
+                "replica": "r1", "replica_pid": R1_PID,
+                "t_mono": _mono(R1_PID, 0.3),
+                "replica_time": WALL + 0.3,
+                "time": WALL + 0.3, "pid": PARENT_PID,
+            },
+            {
+                "kind": "fleet", "event": "fleet-stop",
+                "time": WALL + 5.0, "pid": PARENT_PID,
+            },
+        ],
+    )
+    _jl(
+        run / "replica_r0" / "flight.jsonl",
+        [
+            {
+                "kind": "flight", "phase": "intent", "seq": 1,
+                "program": "serve/b8", "family": "serve",
+                "pid": R0_PID, "trace_ids": [t1],
+                "t_mono": _mono(R0_PID, 1.0), "time": WALL + 1.0,
+            },
+            {
+                "kind": "flight", "phase": "seal", "seq": 1,
+                "program": "serve/b8", "family": "serve", "ok": True,
+                "wall_s": 1.0,
+                "t_mono": _mono(R0_PID, 2.0), "time": WALL + 2.0,
+            },
+        ],
+    )
+    _jl(
+        run / "replica_r1" / "flight.jsonl",
+        [
+            {
+                "kind": "flight", "phase": "intent", "seq": 1,
+                "program": "serve/b8", "family": "serve",
+                "pid": R1_PID, "trace_ids": [t2],
+                "t_mono": _mono(R1_PID, 3.0), "time": WALL + 3.0,
+            },
+            {
+                "kind": "flight", "phase": "seal", "seq": 1,
+                "program": "serve/b8", "family": "serve", "ok": True,
+                "wall_s": 1.0,
+                "t_mono": _mono(R1_PID, 4.0), "time": WALL + 4.0,
+            },
+        ],
+    )
+    return run, (t1, t2)
+
+
+class TestMergeClockSkew:
+    def test_skewed_clocks_yield_causal_flows_and_no_negative_spans(
+        self, tmp_path
+    ):
+        run, (t1, t2) = _skewed_fleet_dir(tmp_path)
+        result = merge_fleet_trace(run)
+        assert sorted(result["flow_trace_ids"]) == sorted([t1, t2])
+        payload = json.loads((run / MERGED_TRACE_FILENAME).read_text())
+        events = payload["traceEvents"]
+        # Calibration found all three processes.
+        assert set(result["clock_offsets"]) == {
+            str(PARENT_PID), str(R0_PID), str(R1_PID)
+        }
+        # No span anywhere has a negative duration, despite the skew.
+        for e in events:
+            if e.get("ph") == "X":
+                assert e["dur"] >= 0, e
+        # Every span sits on the SHARED wall timeline: the replica
+        # serve span for t1 must start inside its route bracket even
+        # though r0's raw monotonic clock is ~950s behind the parent's.
+        route_1 = next(
+            e for e in events
+            if e.get("ph") == "X" and e.get("args", {}).get("trace_id") == t1
+        )
+        serve_1 = next(
+            e for e in events
+            if e.get("ph") == "X"
+            and e.get("pid") == R0_PID
+            and t1 in (e.get("args", {}).get("trace_ids") or [])
+        )
+        assert route_1["ts"] <= serve_1["ts"] <= route_1["ts"] + route_1["dur"]
+        # Flow arrows: per trace_id, source then targets, ts
+        # non-decreasing (the causal-order contract).
+        for tid in (t1, t2):
+            steps = [
+                e for e in events
+                if e.get("cat") == FLOW_CAT and e.get("id") == tid
+            ]
+            assert steps and steps[0]["ph"] == "s"
+            ts = [s["ts"] for s in steps]
+            assert ts == sorted(ts), steps
+            assert steps[-1]["ph"] == "f"
+        # Per-process lanes: one process_name per pid.
+        names = {
+            m["pid"]: m["args"]["name"]
+            for m in events
+            if m.get("name") == "process_name"
+        }
+        assert "fleet parent" in names[PARENT_PID]
+        assert "replica_r0" in names[R0_PID]
+        assert "replica_r1" in names[R1_PID]
+
+    def test_legacy_idless_records_merge_without_arrows(self, tmp_path):
+        run = tmp_path / "legacy"
+        _jl(
+            run / "fleet.jsonl",
+            [{"kind": "fleet", "event": "fleet-start", "time": WALL}],
+        )
+        _jl(
+            run / "flight.jsonl",
+            [
+                {
+                    "kind": "flight", "phase": "intent", "seq": 1,
+                    "program": "fleet/route", "family": "fleet",
+                    "pid": PARENT_PID,
+                    "t_mono": 1.0, "time": WALL + 1.0,
+                },
+                {
+                    "kind": "flight", "phase": "seal", "seq": 1,
+                    "program": "fleet/route", "family": "fleet",
+                    "ok": True, "t_mono": 2.0, "time": WALL + 2.0,
+                },
+            ],
+        )
+        result = merge_fleet_trace(run)
+        assert result["flows"] == 0
+        assert result["route_spans"] == 0  # no trace ids to index
+        payload = json.loads((run / MERGED_TRACE_FILENAME).read_text())
+        assert any(
+            e.get("ph") == "X" for e in payload["traceEvents"]
+        )  # the span still draws
+
+    def test_missing_fleet_ledger_raises(self, tmp_path):
+        try:
+            merge_fleet_trace(tmp_path)
+        except FileNotFoundError:
+            return
+        raise AssertionError("expected FileNotFoundError")
+
+
+# --- SLO engine ----------------------------------------------------------
+
+def _slo_fixture(root, *, sheds=0, p95=20.0, bad_seals=0, now=WALL + 60):
+    """Synthetic fleet run dir: ~100 requests over the last minute."""
+    run = root / f"slo_{sheds}_{p95}_{bad_seals}"
+    _jl(
+        run / "metrics.jsonl",
+        [
+            {
+                "kind": "util", "time": now - 50 + i * 10, "step": i,
+                "window_s": 10.0, "serve_requests_per_sec": 100.0 / 60.0,
+            }
+            for i in range(6)
+        ],
+    )
+    _jl(
+        run / "fleet.jsonl",
+        [{"kind": "fleet", "event": "fleet-start", "time": now - 55}]
+        + [
+            {
+                "kind": "fleet", "event": "shed",
+                "rejection": "queue-full", "time": now - 40 + (i % 30),
+            }
+            for i in range(sheds)
+        ]
+        + [{"kind": "fleet", "event": "fleet-stop", "time": now}],
+    )
+    _jl(
+        run / "replica_r0" / "metrics.jsonl",
+        [
+            {
+                "kind": "util", "time": now - 50 + i * 10, "step": i,
+                "window_s": 10.0, "serve_move_latency_ms_p95": p95,
+                "serve_window_requests": 16,
+            }
+            for i in range(6)
+        ],
+    )
+    _jl(
+        run / "replica_r0" / "flight.jsonl",
+        [
+            {
+                "kind": "flight", "phase": "seal", "family": "serve",
+                "program": "serve/b8", "seq": i, "ok": i >= bad_seals,
+                "time": now - 45 + i * 4,
+            }
+            for i in range(10)
+        ],
+    )
+    return run
+
+
+class TestSLO:
+    def test_healthy_window_is_ok_exit_0(self, tmp_path):
+        report = evaluate_slos(_slo_fixture(tmp_path))
+        assert report["status"] == "ok"
+        assert report["exit_code"] == SLO_EXIT_CODES["ok"] == 0
+        assert {s["name"] for s in report["slos"]} == {
+            "availability", "move-latency-p95", "dispatch-success"
+        }
+
+    def test_brownout_burns_the_availability_budget_exit_1(self, tmp_path):
+        report = evaluate_slos(_slo_fixture(tmp_path, sheds=50))
+        assert report["status"] == "burning"
+        assert report["exit_code"] == 1
+        avail = next(
+            s for s in report["slos"] if s["name"] == "availability"
+        )
+        assert avail["status"] == "burning"
+        # err = 50/150, budget 1% -> burn x33, past both thresholds.
+        assert all(w["burning"] for w in avail["windows"])
+        assert avail["windows"][0]["burn_rate"] > 14.4
+
+    def test_no_data_exit_2(self, tmp_path):
+        report = evaluate_slos(tmp_path)
+        assert report["status"] == "no-data"
+        assert report["exit_code"] == 2
+
+    def test_latency_threshold_flips_the_latency_slo(self, tmp_path):
+        run = _slo_fixture(tmp_path, p95=600.0)
+        burning = evaluate_slos(run)  # default threshold 500ms
+        lat = next(
+            s for s in burning["slos"] if s["name"] == "move-latency-p95"
+        )
+        assert lat["status"] == "burning"
+        ok = evaluate_slos(run, latency_threshold_ms=1000.0)
+        lat = next(
+            s for s in ok["slos"] if s["name"] == "move-latency-p95"
+        )
+        assert lat["status"] == "ok"
+
+    def test_dispatch_failures_count_against_dispatch_success(
+        self, tmp_path
+    ):
+        report = evaluate_slos(_slo_fixture(tmp_path, bad_seals=5))
+        disp = next(
+            s for s in report["slos"] if s["name"] == "dispatch-success"
+        )
+        assert disp["status"] == "burning"
+
+    def test_now_replays_the_alert_state(self, tmp_path):
+        # Evaluated 2h after the brownout, the 300s window is empty and
+        # the 1h window no longer covers the bad minute -> no data.
+        run = _slo_fixture(tmp_path, sheds=50)
+        later = evaluate_slos(run, now=WALL + 60 + 7200)
+        assert later["status"] == "no-data"
+
+    def test_status_line_is_one_line(self, tmp_path):
+        line = slo_status_line(evaluate_slos(_slo_fixture(tmp_path)))
+        assert "\n" not in line and "availability" in line
+
+    def test_prometheus_aggregation(self, tmp_path):
+        report = evaluate_slos(_slo_fixture(tmp_path, sheds=3))
+        path = tmp_path / "fleet.prom"
+        ok = write_fleet_prometheus(
+            path,
+            {
+                "fleet_sheds": 3,
+                "fleet_shed_queue_full": 3,
+                "fleet_shed_no_healthy": 0,
+                "fleet_shed_retries_exhausted": 0,
+                "fleet_requests_per_sec": 12.5,
+            },
+            report,
+            run_name="r1",
+        )
+        assert ok
+        text = path.read_text()
+        # Rejection codes are DISTINCT counter series.
+        assert (
+            "# TYPE alphatriangle_fleet_shed_queue_full_total counter"
+            in text
+        )
+        assert 'alphatriangle_fleet_shed_queue_full_total{run="r1"} 3' in text
+        assert (
+            "# TYPE alphatriangle_fleet_shed_no_healthy_replica_total "
+            "counter" in text
+        )
+        assert "# TYPE alphatriangle_fleet_requests_per_sec gauge" in text
+        assert 'slo="availability"' in text
+        assert "alphatriangle_slo_burn_rate" in text
+
+
+# --- fleet-parent doctor -------------------------------------------------
+
+
+class TestClassifyFleet:
+    def test_empty_ledger_is_never_started(self, tmp_path):
+        (tmp_path / "fleet.jsonl").write_text("")
+        v = classify_fleet(tmp_path)
+        assert v["verdict"] == "never-started" and v["exit_code"] == 2
+
+    def test_torn_route_intent_is_dispatch_hung(self, tmp_path):
+        _jl(
+            tmp_path / "fleet.jsonl",
+            [{"kind": "fleet", "event": "fleet-start", "time": WALL}],
+        )
+        _jl(
+            tmp_path / "flight.jsonl",
+            [
+                {
+                    "kind": "flight", "phase": "intent", "seq": 7,
+                    "program": "fleet/route", "family": "fleet",
+                    "pid": 1, "trace_id": "f" * 32,
+                    "t_mono": 1.0, "time": WALL + 1.0,
+                }
+            ],
+        )
+        v = classify_fleet(tmp_path)
+        assert v["verdict"] == "dispatch-hung" and v["exit_code"] == 4
+        assert "f" * 32 in v["detail"]
+
+    def test_death_without_stop_inherits_the_replica_verdict(
+        self, tmp_path
+    ):
+        _jl(
+            tmp_path / "fleet.jsonl",
+            [
+                {"kind": "fleet", "event": "fleet-start", "time": WALL},
+                {
+                    "kind": "fleet", "event": "death", "replica": "r0",
+                    "rc": 137, "verdict": "oom", "program": "serve/b8",
+                    "family": "serve", "time": WALL + 2,
+                },
+            ],
+        )
+        v = classify_fleet(tmp_path)
+        assert v["verdict"] == "oom" and v["exit_code"] == 6
+        assert v["program"] == "serve/b8"
+
+    def test_fleet_stop_is_clean_despite_healed_deaths(self, tmp_path):
+        _jl(
+            tmp_path / "fleet.jsonl",
+            [
+                {"kind": "fleet", "event": "fleet-start", "time": WALL},
+                {
+                    "kind": "fleet", "event": "death", "replica": "r0",
+                    "rc": 113, "verdict": "dispatch-hung", "time": WALL + 2,
+                },
+                {
+                    "kind": "fleet", "event": "respawn", "replica": "r0",
+                    "time": WALL + 3,
+                },
+                {"kind": "fleet", "event": "fleet-stop", "time": WALL + 9},
+            ],
+        )
+        v = classify_fleet(tmp_path)
+        assert v["verdict"] == "clean" and v["exit_code"] == 0
+        assert v["evidence"]["deaths"] == 1
+
+
+# --- watch fleet line ----------------------------------------------------
+
+
+class TestFleetWatch:
+    def test_fold_and_render(self, tmp_path):
+        path = tmp_path / "fleet.jsonl"
+        ctx = tracectx.mint()
+        _jl(
+            path,
+            [
+                {"kind": "fleet", "event": "fleet-start", "time": WALL},
+                {
+                    "kind": "fleet", "event": "replica-ready",
+                    "replica": "r0", "time": WALL + 1,
+                },
+                {
+                    "kind": "fleet", "event": "replica-ready",
+                    "replica": "r1", "time": WALL + 1,
+                },
+                {
+                    "kind": "fleet", "event": "shed",
+                    "rejection": "queue-full", "inflight": 64,
+                    "time": WALL + 2, **ctx.fields(),
+                },
+                {
+                    "kind": "fleet", "event": "death", "replica": "r1",
+                    "time": WALL + 3,
+                },
+                # Legacy id-less router event folds fine.
+                {
+                    "kind": "fleet", "event": "retry", "replica": "r0",
+                    "attempt": 1, "time": WALL + 4,
+                },
+            ],
+        )
+        state = FleetWatchState()
+        offset = tail_fleet(path, state, 0)
+        assert offset > 0
+        assert state.routable == 1 and len(state.replicas) == 2
+        assert state.sheds == 1 and state.deaths == 1
+        assert state.retries == 1
+        assert state.inflight == 64
+        assert state.shed_per_min > 0
+        line = fleet_line(state)
+        assert "1/2 routable" in line
+        assert "last retry" in line  # newest decision wins
+        # The shed carried a trace id; the retry (legacy) did not, and
+        # rendering must not crash either way.
+        state2 = FleetWatchState()
+        state2.fold_fleet_line(
+            json.dumps(
+                {
+                    "kind": "fleet", "event": "shed",
+                    "rejection": "queue-full", "time": WALL,
+                    **ctx.fields(),
+                }
+            )
+        )
+        assert ctx.trace_id[:8] in fleet_line(state2)
+
+    def test_junk_and_foreign_lines_are_rejected(self):
+        state = FleetWatchState()
+        assert not state.fold_fleet_line("")
+        assert not state.fold_fleet_line("{torn")
+        assert not state.fold_fleet_line(json.dumps({"kind": "util"}))
+        assert fleet_line(state) is None
+
+
+# --- the whole package stays importable without JAX ----------------------
+
+
+def test_tracing_stack_is_jax_free():
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import alphatriangle_tpu.telemetry.tracectx\n"
+        "import alphatriangle_tpu.telemetry.merge\n"
+        "import alphatriangle_tpu.telemetry.slo\n"
+        "import alphatriangle_tpu.stats.watch\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into the readers'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "PYTHONPATH": "."},
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
