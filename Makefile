@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke reuse-smoke
 
 check: lint type test
 
@@ -132,6 +132,18 @@ trace-smoke:
 # for decision-grade timings at flagship shapes.
 ops-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/ops_bench.py
+
+# Subtree-reuse gate (docs/KERNELS.md "subtree_promote"): the batched
+# root-promotion pass over a REAL search tree must match an eager NumPy
+# BFS reference node for node with the Pallas lowering bit-identical to
+# XLA; reuse ON at equal sims must deliver >= 1.15x leaf-evals/s over
+# fresh-root; a short reuse training run must land leaf_evals_per_sec +
+# mcts_reused_visit_fraction (> 0) on the ledger and in `cli perf
+# --json`; and a fixed-seed paired arena through the PolicyService path
+# must show reuse at REDUCED sims score-neutral-or-better vs fresh-root
+# at full sims.
+reuse-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/reuse_smoke.py
 
 # Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
 # under a host-RAM byte limit must emit a tuned_preset.json that

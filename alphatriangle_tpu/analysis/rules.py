@@ -29,7 +29,7 @@ from .model import Finding, Module
 # host-side route bracket): every dispatch of one of these MUST sit
 # inside a FlightRecorder intent/seal bracket, or a wedge inside it is
 # invisible to `cli doctor`.
-FLIGHT_FAMILIES = ("rollout", "learner", "megastep", "serve", "fleet")
+FLIGHT_FAMILIES = ("rollout", "learner", "megastep", "serve", "fleet", "reuse")
 
 _NP_FETCH = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _JIT_TAILS = (".jit", ".pjit")

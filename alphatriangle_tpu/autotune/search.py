@@ -114,6 +114,7 @@ def candidate_mcts(base_mcts, candidate):
         update={
             "descent_gather": candidate.descent_gather,
             "backup_update": candidate.backup_update,
+            "tree_reuse": candidate.tree_reuse,
         }
     )
 

@@ -56,6 +56,12 @@ class Candidate:
     backup_update: str = "xla"  # MCTSConfig.backup_update
     per_sample: str = "xla"  # TrainConfig.PER_SAMPLE_BACKEND
     inference_precision: str = "float32"  # ModelConfig.INFERENCE_PRECISION
+    # MCTSConfig.tree_reuse: NOT memory-free — reuse widens every tree
+    # plane from max_simulations+1 to ~2x that many node slots, so it
+    # appears in oracle_key() alongside the other residency-changing
+    # axes. It is also the one kernel axis that changes per-move search
+    # behavior (carried visits), not just lowering speed.
+    tree_reuse: bool = False
 
     def group_key(self) -> tuple:
         """Axes held fixed under monotone-in-B dominance."""
@@ -69,6 +75,7 @@ class Candidate:
             self.backup_update,
             self.per_sample,
             self.inference_precision,
+            self.tree_reuse,
         )
 
     def oracle_key(self) -> tuple:
@@ -85,6 +92,7 @@ class Candidate:
             self.dp,
             self.descent_gather,
             self.inference_precision,
+            self.tree_reuse,
         )
 
     def kernels(self) -> dict:
@@ -94,6 +102,7 @@ class Candidate:
             "backup_update": self.backup_update,
             "per_sample": self.per_sample,
             "inference_precision": self.inference_precision,
+            "tree_reuse": self.tree_reuse,
         }
 
     def label(self) -> str:
@@ -108,6 +117,7 @@ class Candidate:
                 (f"b-{self.backup_update}", "b-xla"),
                 (f"s-{self.per_sample}", "s-xla"),
                 (f"p-{self.inference_precision}", "p-float32"),
+                (f"r-{'on' if self.tree_reuse else 'off'}", "r-off"),
             )
             if tag != default
         ]
@@ -133,16 +143,18 @@ class SearchSpace:
     backup_updates: list = field(default_factory=lambda: ["xla"])
     per_samples: list = field(default_factory=lambda: ["xla"])
     precisions: list = field(default_factory=lambda: ["float32"])
+    tree_reuses: list = field(default_factory=lambda: [False])
 
     def candidates(self) -> list:
         """Every lattice point, B descending within each group so the
         dominance walk can early-exit on the first feasible lane count."""
         kernel_points = [
-            (g, bu, ps, pr)
+            (g, bu, ps, pr, tr)
             for g in self.descent_gathers
             for bu in self.backup_updates
             for ps in self.per_samples
             for pr in self.precisions
+            for tr in self.tree_reuses
         ]
         out = []
         for geometry in self.geometries:
@@ -150,7 +162,13 @@ class SearchSpace:
                 for chunk in sorted({int(t) for t in self.chunks}):
                     for k in sorted({int(k) for k in self.fused_ks}):
                         for dp in sorted({int(d) for d in self.dps}):
-                            for gather, backup, sample, prec in kernel_points:
+                            for (
+                                gather,
+                                backup,
+                                sample,
+                                prec,
+                                reuse,
+                            ) in kernel_points:
                                 for b in sorted(
                                     {int(b) for b in self.batches},
                                     reverse=True,
@@ -167,6 +185,7 @@ class SearchSpace:
                                             backup_update=backup,
                                             per_sample=sample,
                                             inference_precision=prec,
+                                            tree_reuse=reuse,
                                         )
                                     )
         return out
@@ -183,6 +202,7 @@ class SearchSpace:
             * len(self.backup_updates)
             * len(self.per_samples)
             * len(self.precisions)
+            * len(self.tree_reuses)
         )
 
 

@@ -2578,6 +2578,11 @@ def cmd_tune(args: argparse.Namespace) -> int:
         if getattr(args, "precisions", None)
         else ["float32"]
     )
+    tree_reuses = (
+        [v.strip() == "on" for v in args.tree_reuse.split(",")]
+        if getattr(args, "tree_reuse", None)
+        else [False]
+    )
     space = SearchSpace(
         geometries=geometries,
         batches=batches,
@@ -2588,6 +2593,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
         backup_updates=kernel_backends,
         per_samples=kernel_backends,
         precisions=precisions,
+        tree_reuses=tree_reuses,
     )
 
     calibration = calibration_from_targets(
@@ -3559,6 +3565,15 @@ def main(argv: list[str] | None = None) -> int:
         metavar="DTYPES",
         help="INFERENCE_PRECISION values to search (comma-separated "
         "from float32,bfloat16). Default: float32 only.",
+    )
+    tune.add_argument(
+        "--tree-reuse",
+        default=None,
+        metavar="VALUES",
+        help="MCTS subtree-reuse settings to search (comma-separated "
+        "from off,on — docs/KERNELS.md). Reuse widens the tree planes, "
+        "so 'on' candidates get their own feasibility-oracle answers. "
+        "Default: off only.",
     )
     tune.add_argument(
         "--calibrate",

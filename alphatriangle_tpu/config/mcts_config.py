@@ -46,6 +46,29 @@ class AlphaTriangleMCTSConfig(BaseModel):
     # per-game VMEM kernel, ops/mcts_backup.py). Parity-pinned; a pure
     # performance knob to be settled by on-hardware benchmarks.
     backup_update: str = Field(default="xla", pattern="^(xla|pallas)$")
+    # --- Subtree reuse across moves (the reference's opaque C++ tree
+    # handle, `rl/self_play/worker.py:273-280`; KataGo keeps the chosen
+    # child's subtree too, arXiv:1902.10565). Off by default: fresh-root
+    # search is the v1 reference behavior and stays bit-identical when
+    # this is False. When True, the search runs over a widened node
+    # budget (max_simulations + tree_reuse_budget + 1 slots) and after
+    # each move a static-shape root-promotion pass
+    # (ops/subtree_reuse.py) compacts the chosen child's subtree into
+    # the leading rows — BFS order, freed slots zeroed — so the next
+    # move's search starts with the retained visits already on the
+    # root row. Root prior + Dirichlet noise are always re-taken from a
+    # fresh root evaluation; only edge statistics and interior priors
+    # are carried.
+    tree_reuse: bool = Field(default=False)
+    # How the promotion pass reorders the (B, N, A) edge planes:
+    # "xla" (take_along_axis gathers) or "pallas" (one fused per-game
+    # VMEM row-reorder kernel). Pure copies of identical values, so the
+    # two are bit-identical by construction; parity-pinned anyway.
+    tree_reuse_backend: str = Field(default="xla", pattern="^(xla|pallas)$")
+    # Max nodes retained across a move (root + interior), excluding the
+    # +1 root slot. None -> max_simulations (retain up to a full
+    # search's worth of subtree).
+    tree_reuse_budget: int | None = Field(default=None, gt=0)
     # --- Playout cap randomization (KataGo, arXiv:1902.10565 §3.1;
     # PAPERS.md) — beyond-reference acceleration, off by default. When
     # `fast_simulations` is set, each lockstep move runs the full
@@ -89,6 +112,24 @@ class AlphaTriangleMCTSConfig(BaseModel):
             raise ValueError(
                 "fast_simulations must be < max_simulations "
                 f"({self.fast_simulations} >= {self.max_simulations})"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _check_reuse(self) -> "AlphaTriangleMCTSConfig":
+        if self.tree_reuse and self.fast_simulations is not None:
+            # PCR's fast/full lax.cond needs both branches to share one
+            # carried-tree shape; the fast search has no carried tree.
+            raise ValueError(
+                "tree_reuse is incompatible with playout cap "
+                "randomization (fast_simulations); pick one."
+            )
+        if self.tree_reuse and self.root_selection == "gumbel":
+            # Sequential halving re-plans the root candidate set per
+            # move; carrying a PUCT-shaped subtree across moves would
+            # bias the halving allocation. Not supported.
+            raise ValueError(
+                "tree_reuse is incompatible with root_selection='gumbel'"
             )
         return self
 

@@ -38,11 +38,19 @@ TPU-first design, not a translation of the C++ pointer tree:
 - Terminal nodes evaluate to value 0 and step as no-ops (the engine
   freezes finished games), so finished games in a batch stay in
   lockstep at zero extra cost.
-- Subtree reuse (the reference's opaque tree handle) is intentionally
-  absent: with B games searched per dispatch, re-searching from the
-  root each move keeps shapes static and the MXU saturated; the
-  root-prior already encodes the network's (fresher) knowledge.
-  `wasted_slots` quantifies the orphan overhead this design accepts.
+- Subtree reuse (the reference's opaque tree handle) is OFF by
+  default and static-shape when on (`MCTSConfig.tree_reuse`): the
+  fresh-root default keeps the original v1 behavior bit-identical —
+  re-searching from the root each move, the root-prior encoding the
+  network's (fresher) knowledge, `wasted_slots` quantifying the
+  orphan overhead. With reuse on, the node budget widens to
+  `max_simulations + tree_reuse_budget + 1` slots and a batched
+  root-promotion pass (`ops/subtree_reuse.py`) compacts the chosen
+  child's subtree into the leading rows after each move; the next
+  search merges those carried edge statistics under a *fresh* root
+  evaluation (exact network value, re-applied Dirichlet noise) and
+  inserts new waves at a per-game base — `CarriedTree` rides the
+  caller's scan/session carry, so reuse costs zero extra dispatches.
 """
 
 from typing import Any
@@ -54,7 +62,7 @@ from flax import struct
 from ..config.mcts_config import MCTSConfig
 from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
-from ..ops import backup_update, gather_rows
+from ..ops import backup_update, gather_rows, subtree_promote
 
 
 @struct.dataclass
@@ -70,6 +78,24 @@ class Tree:
     valid: jax.Array  # (B, N, A) f32 1.0 where the action is valid
     terminal: jax.Array  # (B, N) bool
     root_value0: jax.Array  # (B,) f32 network value of the root at init
+
+
+@struct.dataclass
+class CarriedTree:
+    """A promoted search tree carried across moves (subtree reuse).
+
+    `tree` holds the chosen child's subtree compacted into the leading
+    rows (BFS order, freed rows zeroed) by `BatchedMCTS.promote`;
+    `valid[b]` gates the merge (False = next search starts fresh:
+    unexpanded chosen child, episode reset, weight reload, serve lane
+    churn); `base[b]` = retained row count = the next search's
+    insertion base. Rides the caller's carry (rollout scan, megastep
+    program, serve lane state) so reuse never adds a dispatch.
+    """
+
+    tree: Tree
+    valid: jax.Array  # (B,) bool
+    base: jax.Array  # (B,) int32
 
 
 @struct.dataclass
@@ -112,7 +138,16 @@ class BatchedMCTS:
         self.model = model
         self.config = config
         self.support = value_support
-        self.num_nodes = config.max_simulations + 1
+        # Subtree reuse widens the node budget: up to `reuse_slots`
+        # retained rows (promoted subtree incl. its root) plus a full
+        # search's worth of fresh insertions. Fresh-root (the default)
+        # keeps the original max_simulations + 1 exactly.
+        if config.tree_reuse:
+            budget = config.tree_reuse_budget or config.max_simulations
+            self.reuse_slots = budget + 1
+        else:
+            self.reuse_slots = 1
+        self.num_nodes = config.max_simulations + self.reuse_slots
         self.action_dim = env.action_dim
         # Wave size: largest divisor of max_simulations <= mcts_batch_size,
         # so waves tile the simulation budget exactly.
@@ -370,17 +405,30 @@ class BatchedMCTS:
         leaf_values = jnp.where(dones, 0.0, values.reshape(batch, w))
 
         # 4. Insert the wave's W node slots as one block at [base, base+W).
-        def insert(buf, block):
-            return jax.lax.dynamic_update_slice_in_dim(
-                buf, block.astype(buf.dtype), base, axis=1
-            )
+        if jnp.ndim(base) == 0:
+            # Shared scalar base (fresh-root search): a dynamic-slice
+            # block write, the original lowering verbatim.
+            def insert(buf, block):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, block.astype(buf.dtype), base, axis=1
+                )
+
+            slot_ids = (base + warange[None, :]).astype(jnp.float32)  # (1, W)
+        else:
+            # Per-game base (subtree reuse: each game retained a
+            # different row count): scatter rows [base_b, base_b + W).
+            slots = base[:, None] + warange[None, :]  # (B, W)
+
+            def insert(buf, block):
+                return buf.at[bcol, slots].set(block.astype(buf.dtype))
+
+            slot_ids = slots.astype(jnp.float32)
 
         ns = jax.tree_util.tree_map(
             lambda buf, x: insert(buf, x.reshape((batch, w) + x.shape[1:])),
             tree.node_state,
             new_states,
         )
-        slot_ids = (base + warange[None, :]).astype(jnp.float32)  # (1, W)
         live = is_new & is_canon
         tree = tree.replace(
             node_state=ns,
@@ -438,14 +486,9 @@ class BatchedMCTS:
         wasted = wasted + (w - live.sum(axis=1, dtype=jnp.int32))
         return tree, wasted, base + w
 
-    def _search(
-        self, variables, root_states: EnvState, rng: jax.Array
-    ) -> SearchOutput:
-        """Run `max_simulations` batched simulations from `root_states`."""
-        cfg = self.config
-        batch = root_states.done.shape[0]
-        rng, noise_rng, wave_rng = jax.random.split(rng, 3)
-        tree = self._init_tree(variables, root_states, noise_rng)
+    def _run_waves(self, variables, batch: int, tree: Tree, wave_rng, base0):
+        """`num_waves` waves from `tree`; `base0` is the first insertion
+        base — scalar 1 (fresh root) or a per-game (B,) vector (reuse)."""
 
         def wave_body(k, carry):
             tree, wasted, base = carry
@@ -456,14 +499,18 @@ class BatchedMCTS:
                 jax.random.fold_in(wave_rng, k),
             )
 
-        tree, wasted, _ = jax.lax.fori_loop(
+        return jax.lax.fori_loop(
             0,
             self.num_waves,
             wave_body,
-            (tree, jnp.zeros((batch,), jnp.int32), jnp.int32(1)),
+            (tree, jnp.zeros((batch,), jnp.int32), base0),
         )
 
-        # Root stats are just row 0 of the edge planes.
+    def _output_from_tree(
+        self, tree: Tree, wasted: jax.Array, batch: int
+    ) -> SearchOutput:
+        """Root stats are just row 0 of the edge planes."""
+        cfg = self.config
         visit_counts = tree.e_visits[:, 0, :]
         root_visits = 1.0 + visit_counts.sum(axis=-1)
         root_value = (
@@ -477,4 +524,161 @@ class BatchedMCTS:
             wasted_slots=wasted,
             selected_action=jnp.full((batch,), -1, jnp.int32),
             improved_policy=jnp.zeros_like(visit_counts),
+        )
+
+    def _search(
+        self, variables, root_states: EnvState, rng: jax.Array
+    ) -> SearchOutput:
+        """Run `max_simulations` batched simulations from `root_states`."""
+        batch = root_states.done.shape[0]
+        rng, noise_rng, wave_rng = jax.random.split(rng, 3)
+        tree = self._init_tree(variables, root_states, noise_rng)
+        tree, wasted, _ = self._run_waves(
+            variables, batch, tree, wave_rng, jnp.int32(1)
+        )
+        return self._output_from_tree(tree, wasted, batch)
+
+    # --- subtree reuse (MCTSConfig.tree_reuse; ops/subtree_reuse.py) ---
+
+    def _search_carried(
+        self,
+        variables,
+        root_states: EnvState,
+        rng: jax.Array,
+        carried: CarriedTree,
+    ) -> tuple[SearchOutput, Tree, jax.Array]:
+        """`_search` seeded with a promoted tree where `carried.valid`.
+
+        The root row is ALWAYS re-taken from a fresh root evaluation —
+        exact network value (`root_value0`), fresh masked priors with
+        Dirichlet noise re-applied, current-state validity/terminal —
+        so reuse carries only *edge statistics* (visits, returns,
+        rewards, child links) plus interior priors/states. Lanes with
+        `valid=False` reproduce the fresh-root search exactly. Returns
+        `(output, final_tree, reused)` where `reused[b]` counts the
+        root visits inherited from the carry (the leaf evaluations this
+        move did not have to spend).
+        """
+        batch = root_states.done.shape[0]
+        rng, noise_rng, wave_rng = jax.random.split(rng, 3)
+        fresh = self._init_tree(variables, root_states, noise_rng)
+        ct = carried.tree
+        ok = carried.valid  # (B,)
+        okr = ok[:, None, None]
+
+        def merge(c_plane, f_plane):
+            return jnp.where(okr, c_plane, f_plane)
+
+        def merge_state(c, f):
+            okx = ok.reshape((batch,) + (1,) * (c.ndim - 1))
+            m = jnp.where(okx, c, f)
+            # Row 0 always holds the exact current root state (the
+            # promoted row 0 equals it by env determinism; this pins it
+            # structurally rather than by argument).
+            return m.at[:, 0].set(f[:, 0])
+
+        tree = Tree(
+            node_state=jax.tree_util.tree_map(
+                merge_state, ct.node_state, fresh.node_state
+            ),
+            e_visits=merge(ct.e_visits, fresh.e_visits),
+            e_value=merge(ct.e_value, fresh.e_value),
+            e_reward=merge(ct.e_reward, fresh.e_reward),
+            children=merge(ct.children, fresh.children),
+            prior=merge(ct.prior.at[:, 0].set(fresh.prior[:, 0]), fresh.prior),
+            valid=merge(ct.valid.at[:, 0].set(fresh.valid[:, 0]), fresh.valid),
+            terminal=jnp.where(
+                ok[:, None],
+                ct.terminal.at[:, 0].set(fresh.terminal[:, 0]),
+                fresh.terminal,
+            ),
+            root_value0=fresh.root_value0,
+        )
+        reused = jnp.where(ok, ct.e_visits[:, 0, :].sum(axis=-1), 0.0)
+        base0 = jnp.where(ok, jnp.maximum(carried.base, 1), 1).astype(
+            jnp.int32
+        )
+        tree, wasted, _ = self._run_waves(
+            variables, batch, tree, wave_rng, base0
+        )
+        return self._output_from_tree(tree, wasted, batch), tree, reused
+
+    def promote(self, tree: Tree, actions: jax.Array) -> CarriedTree:
+        """Batched root promotion: compact each game's chosen child's
+        subtree into the leading rows (ops/subtree_reuse.py; lowering
+        per `tree_reuse_backend`). `valid` is False where the chosen
+        child was never expanded; callers additionally clear lanes on
+        episode reset / churn."""
+        cfg = self.config
+        (
+            e_visits, e_value, e_reward, children, prior, valid,
+            terminal, state_index, promo_valid, retained,
+        ) = subtree_promote(
+            tree.e_visits,
+            tree.e_value,
+            tree.e_reward,
+            tree.children,
+            tree.prior,
+            tree.valid,
+            tree.terminal,
+            actions.astype(jnp.int32),
+            max_retained=self.reuse_slots,
+            bfs_rounds=cfg.max_depth,
+            mode=cfg.tree_reuse_backend,
+        )
+        batch = actions.shape[0]
+        bcol = jnp.arange(batch)[:, None]
+        node_state = jax.tree_util.tree_map(
+            lambda x: x[bcol, state_index], tree.node_state
+        )
+        promoted = Tree(
+            node_state=node_state,
+            e_visits=e_visits,
+            e_value=e_value,
+            e_reward=e_reward,
+            children=children,
+            prior=prior,
+            valid=valid,
+            terminal=terminal,
+            # Overwritten by the fresh root evaluation on the next
+            # `_search_carried`; zero keeps the carry deterministic.
+            root_value0=jnp.zeros_like(tree.root_value0),
+        )
+        return CarriedTree(
+            tree=promoted,
+            valid=promo_valid,
+            base=jnp.maximum(retained, 1),
+        )
+
+    def zero_carried(self, root_states: EnvState) -> CarriedTree:
+        """An all-invalid carry with the right static shapes (scan /
+        session-lane initialization; `root_states` only donates shapes)."""
+        batch = root_states.done.shape[0]
+        n, a = self.num_nodes, self.action_dim
+
+        def broadcast_to_nodes(x):
+            # .copy() forces a fresh buffer per leaf: the carry is
+            # donated by the rollout chunk, and donating one aliased
+            # buffer through two arguments is an XLA error.
+            return jnp.broadcast_to(x[:, None], (batch, n) + x.shape[1:]).copy()
+
+        def zeros_na():
+            return jnp.zeros((batch, n, a), dtype=jnp.float32)
+
+        return CarriedTree(
+            tree=Tree(
+                node_state=jax.tree_util.tree_map(
+                    broadcast_to_nodes, root_states
+                ),
+                e_visits=zeros_na(),
+                e_value=zeros_na(),
+                e_reward=zeros_na(),
+                children=jnp.full((batch, n, a), -1.0, dtype=jnp.float32),
+                prior=zeros_na(),
+                valid=zeros_na(),
+                terminal=jnp.zeros((batch, n), dtype=bool),
+                root_value0=jnp.zeros((batch,), dtype=jnp.float32),
+            ),
+            valid=jnp.zeros((batch,), dtype=bool),
+            base=jnp.ones((batch,), dtype=jnp.int32),
         )

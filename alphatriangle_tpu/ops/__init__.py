@@ -8,5 +8,6 @@ other by parity tests, with a config knob selecting the backend.
 from .gather_rows import gather_rows
 from .mcts_backup import backup_update
 from .per_sample import per_sample
+from .subtree_reuse import subtree_promote
 
-__all__ = ["backup_update", "gather_rows", "per_sample"]
+__all__ = ["backup_update", "gather_rows", "per_sample", "subtree_promote"]

@@ -713,6 +713,7 @@ class MegastepRunner:
         engine._total_simulations += (
             int(host["trace"]["sims"].sum()) * engine.batch_size
         )
+        engine._total_reused_visits += int(host["trace"]["reused"].sum())
         # The megastep's version clock is the learner step (zero
         # staleness); seed the harvest window tag with the group start.
         engine._min_weights_version = (

@@ -40,6 +40,7 @@ import functools
 import logging
 import threading
 import time
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +78,12 @@ class RolloutCarry:
     pend_active: jax.Array  # (B, n) bool slot occupancy
     episode_start_version: jax.Array  # (B,) int32 weights version at ep start
     move_index: jax.Array  # () int32 global move counter
+    # Promoted search tree carried across moves (mcts/search.py
+    # CarriedTree) when MCTSConfig.tree_reuse is on. None (the default)
+    # is an EMPTY pytree node: the reuse-off carry flattens to exactly
+    # the same leaves as before this field existed, so fresh-root
+    # programs, shardings and donation layouts are bit-identical.
+    tree: Any = None
 
 
 class SelfPlayEngine:
@@ -207,6 +214,13 @@ class SelfPlayEngine:
             episode_start_version=jnp.full((b,), version0, jnp.int32),
             move_index=jnp.int32(0),
         )
+        if mcts_config.tree_reuse:
+            # Subtree reuse: the promoted tree rides the chunk carry
+            # (zero extra dispatches). Starts all-invalid — move 1 of
+            # every lane is a fresh-root search.
+            self._carry = self._carry.replace(
+                tree=self.mcts.zero_carried(self._carry.env)
+            )
         if self._lane_sharding is not None:
             self._carry = jax.device_put(
                 self._carry, self._carry_shardings()
@@ -267,6 +281,10 @@ class SelfPlayEngine:
         self._episodes_played = 0
         self._episodes_truncated = 0
         self._total_simulations = 0
+        # Root visits inherited from carried subtrees (tree_reuse);
+        # summed with simulations this gives leaf-equivalent search
+        # effort (leaf-evals/s in telemetry/perf.py).
+        self._total_reused_visits = 0
         # Cumulative host-blocking harvest-fetch seconds (the chunk's
         # device_get — includes any wait for the chunk to finish, i.e.
         # the host-visible round-trip cost telemetry/perf.py reports).
@@ -301,6 +319,13 @@ class SelfPlayEngine:
             pend_active=lane,
             episode_start_version=lane,
             move_index=rep,
+            # Every CarriedTree leaf is (B, ...): lane-sharded like the
+            # env states. None (reuse off) stays the empty pytree node.
+            tree=(
+                None
+                if self._carry.tree is None
+                else jax.tree_util.tree_map(lambda _: lane, self._carry.tree)
+            ),
         )
 
     def _place_variables(self, variables, version: int):
@@ -386,7 +411,18 @@ class SelfPlayEngine:
         # per-move (not per-game) draw, which keeps the batch lanes in
         # lockstep while matching KataGo's per-move distribution.
         grids, others = jax.vmap(self.extractor.extract)(states)
-        if self.mcts_fast is None:
+        final_tree = None
+        reused = None
+        if self.mcts_config.tree_reuse:
+            # Subtree reuse (incompatible with PCR/Gumbel — config-
+            # validated): seed this move's search with the carried
+            # promoted tree; lanes with an invalid carry run fresh.
+            out, final_tree, reused = self.mcts._search_carried(
+                variables, states, k_search, carry.tree
+            )
+            is_full = jnp.bool_(True)
+            sims_this_move = jnp.int32(self.mcts_config.max_simulations)
+        elif self.mcts_fast is None:
             out = self.mcts._search(variables, states, k_search)
             is_full = jnp.bool_(True)
             sims_this_move = jnp.int32(self.mcts_config.max_simulations)
@@ -521,6 +557,14 @@ class SelfPlayEngine:
             ending, version, carry.episode_start_version
         )
 
+        # 9. Root promotion for the next move (subtree reuse): compact
+        # the played action's subtree into the leading rows; ending
+        # lanes reset to a fresh search (their next root is a new game).
+        new_tree = carry.tree
+        if final_tree is not None:
+            new_tree = self.mcts.promote(final_tree, actions)
+            new_tree = new_tree.replace(valid=new_tree.valid & ~ending)
+
         new_carry = RolloutCarry(
             env=reset_states,
             rng=rng,
@@ -533,6 +577,7 @@ class SelfPlayEngine:
             pend_active=pend_active,
             episode_start_version=episode_start_version,
             move_index=carry.move_index + 1,
+            tree=new_tree,
         )
         outputs = {
             "mat": mat,
@@ -553,6 +598,14 @@ class SelfPlayEngine:
                 # and whether it was a full (policy-training) search.
                 "sims": sims_this_move,
                 "is_full": is_full,
+                # Root visits inherited from the carried subtree this
+                # move (0 with reuse off) — the leaf evaluations the
+                # search did not have to spend; feeds leaf-evals/s.
+                "reused": (
+                    reused
+                    if reused is not None
+                    else jnp.zeros_like(out.root_value)
+                ),
             },
         }
         return new_carry, outputs
@@ -621,6 +674,7 @@ class SelfPlayEngine:
         self._total_simulations += (
             int(host["trace"]["sims"].sum()) * self.batch_size
         )
+        self._total_reused_visits += int(host["trace"]["reused"].sum())
 
         self.last_trace = host["trace"]
         episode = host["episode"]
@@ -756,6 +810,7 @@ class SelfPlayEngine:
             num_episodes=self._episodes_played,
             num_truncated=self._episodes_truncated,
             total_simulations=self._total_simulations,
+            total_reused_visits=self._total_reused_visits,
             trainer_step_at_episode_start=(
                 self._min_weights_version
                 if self._min_weights_version is not None
@@ -769,5 +824,6 @@ class SelfPlayEngine:
         self._episodes_played = 0
         self._episodes_truncated = 0
         self._total_simulations = 0
+        self._total_reused_visits = 0
         self._min_weights_version = None
         return result

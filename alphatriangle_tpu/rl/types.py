@@ -41,6 +41,10 @@ class SelfPlayResult(BaseModel):
     # over (a persistently high fraction means the cap is biting).
     num_truncated: int = 0
     total_simulations: int = 0
+    # Root visits inherited from carried subtrees (MCTS tree_reuse);
+    # 0 with reuse off. simulations + reused = leaf-equivalent search
+    # effort per harvest (telemetry leaf-evals/s).
+    total_reused_visits: int = 0
     # Weight version the producing rollout ran with (staleness tag,
     # reference `rl/types.py:22` / `worker.py:136-139`).
     trainer_step_at_episode_start: int = 0

@@ -112,9 +112,48 @@ class PolicyService:
             f"|{type(mcts).__name__}"
             f"|exploit{int(getattr(mcts, 'exploit', False))}"
         )
-        self._search = get_compile_cache().wrap(
-            serve_program_name(slots), mcts.search, extra=extra
-        )
+        # Subtree reuse (MCTSConfig.tree_reuse): each lane carries its
+        # promoted search tree across dispatches, device-resident. The
+        # serve program then fuses search + in-program action argmax +
+        # root promotion into the same single dispatch; the host keeps
+        # a per-lane validity mask (`_carry_ok`) and clears lanes on
+        # churn (admit/retire), episode end, weight reload (carried
+        # priors/visits came from the old net) and any lane the wave's
+        # promotion advanced but the masked step did not (unserved
+        # lanes must not inherit a tree for a move they never played).
+        self._tree_reuse = bool(getattr(mcts.config, "tree_reuse", False))
+        self._carry_ok = np.zeros(slots, dtype=bool)
+        self._carried = None
+        if self._tree_reuse:
+            import jax.numpy as jnp
+
+            def _serve_search_reuse(variables, states, rng, carried, ok):
+                eff = carried.replace(valid=carried.valid & ok)
+                out, tree, reused = mcts._search_carried(
+                    variables, states, rng, eff
+                )
+                counts = out.visit_counts
+                # Device replica of select_root_actions' PUCT rule
+                # (helpers.py: argmax of visits, 0 on zero-visit rows)
+                # — same values the host selects, so the promotion
+                # follows exactly the action the masked step plays.
+                actions = jnp.where(
+                    counts.sum(axis=-1) > 0,
+                    jnp.argmax(counts, axis=-1).astype(jnp.int32),
+                    0,
+                )
+                return out, mcts.promote(tree, actions), reused
+
+            self._carried = mcts.zero_carried(self.sessions.states)
+            self._search = get_compile_cache().wrap(
+                serve_program_name(slots),
+                jax.jit(_serve_search_reuse),
+                extra=extra,
+            )
+        else:
+            self._search = get_compile_cache().wrap(
+                serve_program_name(slots), mcts.search, extra=extra
+            )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.RLock()
         self._queue: deque[int] = deque()  # sids with a pending request
@@ -128,6 +167,10 @@ class PolicyService:
         self.requests_total = 0
         self.episodes_done_total = 0
         self.simulations_total = 0
+        # Root visits inherited from carried subtrees across all waves
+        # (0 unless tree_reuse): simulations + reused = leaf-equivalent
+        # search effort (leaf-evals/s in telemetry/perf.py).
+        self.reused_visits_total = 0
         self.weight_reloads = 0
         # Per-tick windows (drained by tick()).
         self._win_wait_ms: list[float] = []
@@ -167,11 +210,17 @@ class PolicyService:
     def _sample_args(self):
         import jax
 
-        return (
+        args = (
             self._serve_variables(),
             self.sessions.states,
             jax.random.PRNGKey(0),
         )
+        if self._tree_reuse:
+            args += (
+                self._carried,
+                jax.numpy.zeros(self.sessions.slots, dtype=bool),
+            )
+        return args
 
     def warm(self) -> bool:
         """AOT-ready the serve program for this slot shape (deserialize
@@ -195,11 +244,16 @@ class PolicyService:
         if reset_key is None:
             reset_key = jax.random.PRNGKey(0 if seed is None else seed)
         with self._lock:
-            return self.sessions.admit(reset_key)
+            s = self.sessions.admit(reset_key)
+            self._carry_ok[s.slot] = False
+            return s
 
     def open_sessions(self, reset_keys) -> list:
         with self._lock:
-            return self.sessions.admit_many(reset_keys)
+            admitted = self.sessions.admit_many(reset_keys)
+            for s in admitted:
+                self._carry_ok[s.slot] = False
+            return admitted
 
     def set_session_trace(self, sid: int, fields: "dict | None") -> None:
         """Attach (or clear) the trace-context fields of the request
@@ -216,6 +270,7 @@ class PolicyService:
             s = self.sessions.session(sid)
             s.pending_since = None
             self._session_trace.pop(sid, None)
+            self._carry_ok[s.slot] = False
             summary = self.sessions.retire(sid)
             if sid in self._queue:
                 self._queue.remove(sid)
@@ -254,6 +309,12 @@ class PolicyService:
             if variables is not None:
                 self.net.set_weights(variables)
             self.weight_reloads += 1
+            # Carried subtrees were searched under the old net: their
+            # interior priors/values no longer match what a fresh
+            # search would compute. Reload churn resets every lane to
+            # fresh-root (the documented cost of reuse under high
+            # reload rates, docs/KERNELS.md).
+            self._carry_ok[:] = False
             return self.weight_reloads
 
     # --- the micro-batch dispatch ---------------------------------------
@@ -314,9 +375,23 @@ class PolicyService:
                         self.dispatch_count,
                         flight_path=getattr(self.flight, "path", None),
                     )
-                out = self._search(
-                    self._serve_variables(), self.sessions.states, rng
-                )
+                reused_d = None
+                if self._tree_reuse:
+                    import jax.numpy as jnp
+
+                    # Same single dispatch: search seeded with the
+                    # carried lanes + fused in-program promotion.
+                    out, self._carried, reused_d = self._search(
+                        self._serve_variables(),
+                        self.sessions.states,
+                        rng,
+                        self._carried,
+                        jnp.asarray(self._carry_ok),
+                    )
+                else:
+                    out = self._search(
+                        self._serve_variables(), self.sessions.states, rng
+                    )
                 actions = select_root_actions(out, self.use_gumbel)
                 # The positions the search ran on; the pytree stays
                 # valid after step() installs the successor states.
@@ -324,10 +399,12 @@ class PolicyService:
                 rewards, dones = self.sessions.step(actions, mask)
                 # Response materialization: the host sync IS the
                 # product here (clients need their move) — ONE fetch
-                # per dispatch for all three result arrays, not three.
-                rewards_np, dones_np, scores_np = jax.device_get(  # graftlint: allow(host-sync-in-hot-path) the one deliberate response fetch per dispatch
-                    (rewards, dones, self.sessions.states.score)
-                )
+                # per dispatch for all result arrays, not one each.
+                fetch = (rewards, dones, self.sessions.states.score)
+                if reused_d is not None:
+                    fetch += (reused_d,)
+                host = jax.device_get(fetch)  # graftlint: allow(host-sync-in-hot-path) the one deliberate response fetch per dispatch
+                rewards_np, dones_np, scores_np = host[:3]
             t1 = self._clock()
 
             if self.emitter is not None:
@@ -382,6 +459,14 @@ class PolicyService:
             self.simulations_total += (
                 self.sessions.slots * self.mcts.config.max_simulations
             )
+            if reused_d is not None:
+                # Visits the wave inherited instead of re-searching
+                # (same full-array accounting as simulations_total).
+                self.reused_visits_total += int(host[3].sum())
+                # Next wave may reuse only lanes this wave actually
+                # advanced (served + stepped) and that didn't finish;
+                # unserved lanes were promoted for a move never played.
+                self._carry_ok = mask & ~np.asarray(dones_np, dtype=bool)
             self._win_requests += len(results)
             self._win_batch_ms.append(batch_ms)
             self._win_fill.append(len(results) / self.sessions.slots)
@@ -454,6 +539,7 @@ class PolicyService:
             episodes=self.episodes_done_total,
             experiences=self.requests_total,
             simulations=self.simulations_total,
+            reused_visits=self.reused_visits_total,
             buffer_size=self.queue_depth,
             extra={k: v for k, v in stats.items() if v is not None},
         )

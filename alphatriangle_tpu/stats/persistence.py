@@ -180,15 +180,30 @@ class CheckpointManager:
         with self._commit_lock:
             if steps is None:
                 steps = set(self._pending_commits)
-            self._pending_commits -= steps
         if not is_primary():
+            with self._commit_lock:
+                self._pending_commits -= steps
             return
+        # Only settle steps whose tree is actually on disk: a flusher
+        # whose wait raced a concurrent save dispatch may observe a
+        # step before its directory finalizes, and dropping it from
+        # pending here would silently lose the commit forever — keep
+        # it pending for the next settle point instead.
+        written = set()
         for step in sorted(steps):
             if (self._ckpt_dir / f"step_{step:08d}").is_dir():
-                _atomic_write_text(
-                    self._commit_marker_path(step),
-                    json.dumps({"global_step": step}),
-                )
+                try:
+                    _atomic_write_text(
+                        self._commit_marker_path(step),
+                        json.dumps({"global_step": step}),
+                    )
+                except OSError:
+                    # The run dir vanished under the writer (external
+                    # cleanup/teardown): nothing left to certify.
+                    pass
+                written.add(step)
+        with self._commit_lock:
+            self._pending_commits -= written
 
     def _prune_checkpoints(self, just_saved: int) -> None:
         keep = self.config.KEEP_LAST_CHECKPOINTS
@@ -270,6 +285,12 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._ckptr.wait_until_finished()
+        # Settle the background flusher too: after this returns, every
+        # landed save is marker-committed and no daemon write is still
+        # in flight (callers may tear the run dir down next).
+        flusher = self._flusher
+        if flusher is not None and flusher.is_alive():
+            flusher.join()
         self._flush_commit_markers()
 
     def close(self) -> None:

@@ -100,6 +100,12 @@ def program_family(program: str) -> str:
         # host-side fan-out, but bracketed the same way so an unsealed
         # route names the request the fleet parent died holding.
         return "fleet"
+    if head == "reuse":
+        # Standalone subtree-promotion programs (`reuse/promote_*`,
+        # ops/subtree_reuse.py): the training/serve paths fuse the
+        # promotion into their own dispatches, but the parity bench and
+        # smoke run it as its own hot program — same forensics contract.
+        return "reuse"
     return head
 
 

@@ -49,6 +49,16 @@ COMPARE_METRICS = (
     "games_per_hour",
     "moves_per_sec",
     "learner_steps_per_sec",
+    # Leaf-equivalent search effort per second: fresh simulations plus
+    # root visits inherited through MCTS subtree reuse
+    # (ops/subtree_reuse.py). The headline search-throughput number —
+    # higher is better; with reuse off it equals sims/s exactly.
+    "leaf_evals_per_sec",
+    # Fraction of leaf-eval effort that was inherited rather than
+    # re-searched (0 with reuse off). Informational next to the rate:
+    # a run whose fraction collapses is re-searching work it used to
+    # carry (e.g. reload churn clearing lanes).
+    "mcts_reused_visit_fraction",
     "mfu",
     "mem_peak_bytes_in_use",
     "memory_budget_bytes",
@@ -130,6 +140,7 @@ class UtilizationMeter:
         episodes: int = 0,
         experiences: int = 0,
         simulations: int = 0,
+        reused_visits: int = 0,
         buffer_size: int = 0,
         transfer_h2d_s: float = 0.0,
         transfer_d2h_s: float = 0.0,
@@ -156,6 +167,7 @@ class UtilizationMeter:
             "episodes": episodes,
             "experiences": experiences,
             "simulations": simulations,
+            "reused_visits": reused_visits,
             "transfer_h2d_s": transfer_h2d_s,
             "transfer_d2h_s": transfer_d2h_s,
             "dispatches": dispatches,
@@ -171,6 +183,11 @@ class UtilizationMeter:
         steps_s = max(0.0, d["step"]) / dt
         moves_s = max(0.0, d["experiences"]) / dt
         sims_s = max(0.0, d["simulations"]) / dt
+        # Leaf-equivalent effort: fresh simulations plus visits carried
+        # across moves by subtree reuse (MCTSConfig.tree_reuse). With
+        # reuse off the delta is 0 and leaf-evals/s == sims/s exactly.
+        reused_s = max(0.0, d["reused_visits"]) / dt
+        leaf_s = sims_s + reused_s
         # Achieved model FLOP/s: learner steps x analytic step FLOPs +
         # self-play net evals (one per simulation leaf + ~one root eval
         # per move; experiences/s approximates moves x lanes).
@@ -198,6 +215,10 @@ class UtilizationMeter:
                 max(0.0, d["episodes"]) * 3600.0 / dt, 2
             ),
             "sims_per_sec": round(sims_s, 1),
+            "leaf_evals_per_sec": round(leaf_s, 1),
+            "mcts_reused_visit_fraction": (
+                round(reused_s / leaf_s, 4) if leaf_s > 0 else None
+            ),
             # 6+8 decimals: a test-sized net on CPU runs ~1e-6 TFLOP/s
             # and must not round its MFU down to an ambiguous 0.0.
             "tflops_per_sec": round(tflops, 6),
@@ -416,6 +437,10 @@ def summarize_utilization(
         "moves_per_sec": _mean(col("moves_per_sec")),
         "games_per_hour": _mean(col("games_per_hour")),
         "sims_per_sec": _mean(col("sims_per_sec")),
+        "leaf_evals_per_sec": _mean(col("leaf_evals_per_sec")),
+        "mcts_reused_visit_fraction": _mean(
+            col("mcts_reused_visit_fraction")
+        ),
         "tflops_per_sec": _mean(col("tflops_per_sec")),
         "mfu": _mean(mfus),
         "mfu_max": max(mfus) if mfus else None,
@@ -573,6 +598,10 @@ def _summary_from_bench(payload: dict, label: str) -> "dict | None":
         "source": label,
         "games_per_hour": payload.get("value"),
         "moves_per_sec": extra.get("moves_per_sec"),
+        "leaf_evals_per_sec": extra.get("leaf_evals_per_sec"),
+        "mcts_reused_visit_fraction": extra.get(
+            "mcts_reused_visit_fraction"
+        ),
         "learner_steps_per_sec": (
             extra.get("learner_steps_per_sec_fused")
             or extra.get("learner_steps_per_sec")

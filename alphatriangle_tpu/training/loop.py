@@ -76,6 +76,9 @@ class TrainingLoop:
         self.global_step = 0
         self.episodes_played = 0
         self.total_simulations = 0
+        # Root visits inherited through MCTS subtree reuse (0 unless
+        # MCTSConfig.tree_reuse): feeds the leaf-evals/s gauge.
+        self.total_reused_visits = 0
         self.weight_updates = 0
         self.experiences_added = 0  # this run (resume-independent)
         self._steps_this_run = 0
@@ -234,6 +237,7 @@ class TrainingLoop:
             added = result.num_experiences
         self.episodes_played += result.num_episodes
         self.total_simulations += result.total_simulations
+        self.total_reused_visits += result.total_reused_visits
         step = self.global_step
         events = [
             RawMetricEvent(
@@ -1278,6 +1282,7 @@ class TrainingLoop:
             episodes=self.episodes_played,
             experiences=self.experiences_added,
             simulations=self.total_simulations,
+            reused_visits=self.total_reused_visits,
             buffer_size=len(self.c.buffer),
             transfer_h2d_s=h2d,
             transfer_d2h_s=d2h,

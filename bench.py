@@ -49,6 +49,8 @@ Env knobs:
   BENCH_PROFILE=1       capture an XLA trace of the first ~3 measured
                         chunks (BENCH_PROFILE_DIR, default
                         benchmarks/bench_profile); read with cli analyze
+  BENCH_TREE_REUSE=0    skip the subtree-reuse A/B section (the headline
+                        sections always measure fresh-root either way)
   JAX_PLATFORMS=cpu     skip the probe, run straight on CPU
   BENCH_CHILD=1         internal: marks the supervised measurement child
 """
@@ -312,10 +314,16 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     episodes = result.num_episodes
     games_per_hour = episodes / elapsed * 3600.0
     # Engine-reported sims (exact under playout cap randomization too)
-    # + one root eval per move.
+    # + visits inherited through subtree reuse (0 on the fresh-root
+    # default plan) + one root eval per move.
     leaf_evals_per_sec = (
-        result.total_simulations + moves * sp_batch
+        result.total_simulations
+        + result.total_reused_visits
+        + moves * sp_batch
     ) / elapsed
+    reused_fraction = result.total_reused_visits / max(
+        1, result.total_simulations + result.total_reused_visits
+    )
     moves_per_sec = moves * sp_batch / elapsed
     log(
         f"bench: {moves} lockstep moves x {sp_batch} games in {elapsed:.1f}s "
@@ -363,6 +371,8 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "backup_update": mcts_cfg.backup_update,
             "per_sample": train_cfg.PER_SAMPLE_BACKEND,
             "inference_precision": model_cfg.INFERENCE_PRECISION,
+            "tree_reuse": mcts_cfg.tree_reuse,
+            "tree_reuse_backend": mcts_cfg.tree_reuse_backend,
         },
         "self_play_batch": sp_batch,
         "mcts_simulations": sims,
@@ -376,6 +386,10 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         ),
         "moves_per_sec": round(moves_per_sec, 1),
         "mcts_leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
+        # Compare-facing aliases (telemetry/perf.py _summary_from_bench
+        # reads these into the `cli compare` rows).
+        "leaf_evals_per_sec": round(leaf_evals_per_sec, 1),
+        "mcts_reused_visit_fraction": round(reused_fraction, 4),
         "first_chunk_compile_seconds": round(compile_s, 1),
         "device_kind": device_kind,
         "flops": {
@@ -416,6 +430,98 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         return r
 
     emit(snapshot("self_play"))
+
+    # --- subtree-reuse A/B (MCTSConfig.tree_reuse) ----------------------
+    # Same plan with reuse flipped on: the carried-tree engine measures
+    # its own leaf-evals/s window against a matched fresh-root rate.
+    # The headline sections always run fresh-root, so BENCH_TREE_REUSE=0
+    # (skip) and =1 (run the extra section) emit identical headline
+    # numbers — the A/B only ADDS extra["tree_reuse"]. Skipped under
+    # recipes reuse cannot compose with (gumbel roots, playout cap
+    # randomization — config/mcts_config.py validators).
+    reuse_compatible = (
+        mcts_cfg.root_selection != "gumbel"
+        and mcts_cfg.fast_simulations is None
+    )
+    if os.environ.get("BENCH_TREE_REUSE", "1") != "0" and reuse_compatible:
+        # A single-wave plan (wave >= sims) builds a depth-1 tree whose
+        # promoted child has no expanded edges — nothing to carry. The
+        # A/B then drops to a 2-wave geometry on BOTH sides and measures
+        # its own matched fresh-root baseline; otherwise the headline
+        # rate above is already the matched comparator.
+        reuse_wave = mcts_cfg.mcts_batch_size
+        fresh_comparator = leaf_evals_per_sec
+        if reuse_wave >= sims:
+            reuse_wave = max(1, sims // 2)
+            match_cfg = mcts_cfg.model_copy(
+                update={"mcts_batch_size": reuse_wave}
+            )
+            match_engine = SelfPlayEngine(
+                env, extractor, net, match_cfg, train_cfg, seed=0
+            )
+            log("bench: compiling matched fresh-root chunk (2-wave)...")
+            match_engine.play_chunk()
+            match_engine.harvest()
+            m_seconds = min(seconds, 15.0)
+            t0 = time.time()
+            m_moves = 0
+            while time.time() - t0 < m_seconds:
+                match_engine.play_chunk()
+                m_moves += chunk
+            m_elapsed = time.time() - t0
+            m_result = match_engine.harvest()
+            fresh_comparator = (
+                m_result.total_simulations
+                + m_result.total_reused_visits
+                + m_moves * sp_batch
+            ) / m_elapsed
+        reuse_cfg = mcts_cfg.model_copy(
+            update={"tree_reuse": True, "mcts_batch_size": reuse_wave}
+        )
+        reuse_engine = SelfPlayEngine(
+            env, extractor, net, reuse_cfg, train_cfg, seed=0
+        )
+        log("bench: compiling reuse self-play chunk (first dispatch)...")
+        t0 = time.time()
+        reuse_engine.play_chunk()
+        reuse_compile_s = time.time() - t0
+        reuse_engine.harvest()
+        reuse_seconds = min(seconds, 15.0)
+        t0 = time.time()
+        r_moves = 0
+        while time.time() - t0 < reuse_seconds:
+            reuse_engine.play_chunk()
+            r_moves += chunk
+        r_elapsed = time.time() - t0
+        r_result = reuse_engine.harvest()
+        r_leaf = (
+            r_result.total_simulations
+            + r_result.total_reused_visits
+            + r_moves * sp_batch
+        ) / r_elapsed
+        r_fraction = r_result.total_reused_visits / max(
+            1,
+            r_result.total_simulations + r_result.total_reused_visits,
+        )
+        extra["tree_reuse"] = {
+            "backend": reuse_cfg.tree_reuse_backend,
+            "wave": reuse_wave,
+            "seconds": round(r_elapsed, 1),
+            "compile_seconds": round(reuse_compile_s, 1),
+            "moves_per_sec": round(r_moves * sp_batch / r_elapsed, 1),
+            "leaf_evals_per_sec": round(r_leaf, 1),
+            "reused_visit_fraction": round(r_fraction, 4),
+            # The acceptance ratio: reuse-on leaf-equivalent search
+            # effort per wall second over the matched fresh-root rate
+            # at equal sims and wave.
+            "speedup_vs_fresh": (
+                round(r_leaf / fresh_comparator, 3)
+                if fresh_comparator > 0
+                else None
+            ),
+        }
+        log(f"bench: tree_reuse {extra['tree_reuse']}")
+        emit(snapshot("tree_reuse"))
 
     # --- learner steps/sec (secondary) ----------------------------------
     trainer = Trainer(net, train_cfg)
@@ -815,6 +921,15 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "games_per_hour": round(m_games_per_hour, 1),
             "moves_per_sec": round(m_moves_per_sec, 1),
             "learner_steps_per_sec": round(m_steps_per_sec, 2),
+            "leaf_evals_per_sec": round(
+                (
+                    m_result.total_simulations
+                    + m_result.total_reused_visits
+                    + m_moves * sp_batch
+                )
+                / m_elapsed,
+                1,
+            ),
             "vs_overlapped": (
                 round(vs_overlapped, 3) if vs_overlapped else None
             ),
@@ -1009,6 +1124,22 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "seconds": serve_stats["seconds"],
             "compile_seconds": round(serve_compile_s, 1),
             "requests_per_sec": serve_stats["moves_per_sec"],
+            # Device search effort per wall second: full-array sims +
+            # reused visits (0 unless the plan serves with tree_reuse)
+            # + one root eval per dispatched lane.
+            "leaf_evals_per_sec": (
+                round(
+                    (
+                        serve_service.simulations_total
+                        + serve_service.reused_visits_total
+                        + serve_service.dispatch_count * serve_slots
+                    )
+                    / serve_stats["seconds"],
+                    1,
+                )
+                if serve_stats["seconds"]
+                else None
+            ),
             "move_latency_ms_p50": slo["serve_move_latency_ms_p50"],
             "move_latency_ms_p95": slo["serve_move_latency_ms_p95"],
             "queue_wait_ms_p95": slo["serve_queue_wait_ms_p95"],

@@ -1,0 +1,431 @@
+"""CI reuse-smoke gate: MCTS subtree reuse end to end on CPU.
+
+`make reuse-smoke` runs this. It proves, on any machine with no
+accelerator, that the subtree-reuse path (ops/subtree_reuse.py,
+`MCTSConfig.tree_reuse` — docs/KERNELS.md) holds its three contracts:
+
+1. **Promotion parity.** `subtree_promote` over a REAL search tree must
+   match an eager NumPy BFS reference node for node (order, budget
+   truncation, children remap, freed-row fills, state gather plan), and
+   the `"pallas"` lowering must be bit-identical to `"xla"`. This is
+   the semantic pin the jitted scatter-min/argsort plan is held to.
+2. **Throughput + telemetry.** Reuse ON at equal sims must deliver
+   >= 1.15x leaf-evals/s over the fresh-root engine (the ISSUE 17
+   acceptance ratio), and a short reuse training run must land
+   `leaf_evals_per_sec` + `mcts_reused_visit_fraction` (> 0) on the
+   ledger's util records and in `cli perf --json`.
+3. **Strength.** A fixed-seed paired arena (arena.play_service, the
+   full PolicyService queue/dispatch path) of reuse at REDUCED sims vs
+   fresh-root at full sims must be score-neutral-or-better — the bet
+   that carried visits buy back search budget, gated deterministically.
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise.
+"""
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from collections import deque
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RUN_NAME = "reuse_smoke"
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+# Must precede any jax import: the smoke must not wake (or wedge on) an
+# accelerator, and the peak override is what makes CPU MFU non-null.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
+
+import numpy as np  # noqa: E402
+
+SPEEDUP_BAR = 1.15  # ISSUE 17 acceptance: reuse leaf-evals/s multiple
+FULL_SIMS = 8
+REDUCED_SIMS = 6  # arena gate: reuse must not lose strength here
+ARENA_GAMES = 16
+ARENA_MAX_MOVES = 30
+
+
+def eager_promote(planes, terminal, actions, max_retained):
+    """Pure-NumPy reference for `subtree_promote`: literal BFS from the
+    chosen child, depth-major stable order, budget truncation with
+    parent-before-child consistency, children remapped to new ids (edges
+    to dropped nodes -> -1), freed rows zeroed (children -1), terminal
+    masked, state_index mirroring the root broadcast on freed rows."""
+    ev, eq, er, ch, pr, va = [np.asarray(p, np.float32) for p in planes]
+    term = np.asarray(terminal, bool)
+    acts = np.asarray(actions, np.int64)
+    b_n, n, a_dim = ev.shape
+    outs = [np.zeros_like(p) for p in (ev, eq, er, ch, pr, va)]
+    outs[3][:] = -1.0
+    term_out = np.zeros_like(term)
+    state_index = np.zeros((b_n, n), np.int32)
+    promo_valid = np.zeros(b_n, bool)
+    retained = np.zeros(b_n, np.int32)
+    for b in range(b_n):
+        c0 = int(ch[b, 0, acts[b]])
+        if c0 < 0:
+            continue  # invalid lane: zeroed planes, state_index -> 0
+        promo_valid[b] = True
+        depth = {c0: 0}
+        dq = deque([c0])
+        while dq:
+            u = dq.popleft()
+            for act in range(a_dim):
+                v = int(ch[b, u, act])
+                if v >= 0 and v not in depth:
+                    depth[v] = depth[u] + 1
+                    dq.append(v)
+        order = sorted(depth, key=lambda u: (depth[u], u))
+        rank = {u: r for r, u in enumerate(order)}
+        ret = min(len(order), max_retained)
+        retained[b] = ret
+        for r, u in enumerate(order[:ret]):
+            for i, plane in enumerate((ev, eq, er, None, pr, va)):
+                if plane is not None:
+                    outs[i][b, r] = plane[b, u]
+            for act in range(a_dim):
+                v = int(ch[b, u, act])
+                kept = v >= 0 and v in rank and rank[v] < max_retained
+                outs[3][b, r, act] = float(rank[v]) if kept else -1.0
+            term_out[b, r] = term[b, u]
+        state_index[b, :ret] = order[:ret]
+        state_index[b, ret:] = c0
+    return outs, term_out, state_index, promo_valid, retained
+
+
+def tiny_world():
+    """The training perf smoke's tiny world with a PUCT search config
+    sized so reuse has subtree to carry."""
+    from perf_smoke import tiny_configs
+
+    from alphatriangle_tpu.config import AlphaTriangleMCTSConfig
+
+    env_cfg, model_cfg, _mcts, train_cfg = tiny_configs()
+    mcts_cfg = AlphaTriangleMCTSConfig(
+        max_simulations=FULL_SIMS, max_depth=5, mcts_batch_size=4
+    )
+    return env_cfg, model_cfg, mcts_cfg, train_cfg
+
+
+def build_world():
+    from alphatriangle_tpu.env.engine import TriangleEnv
+    from alphatriangle_tpu.features.core import get_feature_extractor
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_world()
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    return env_cfg, model_cfg, mcts_cfg, train_cfg, env, extractor, net
+
+
+def stage_parity() -> int:
+    """Stage 1: subtree_promote vs the eager reference, xla == pallas."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphatriangle_tpu.mcts.search import BatchedMCTS
+    from alphatriangle_tpu.ops import subtree_promote
+
+    (_env_cfg, _model_cfg, mcts_cfg, _train_cfg, env, extractor, net) = (
+        build_world()
+    )
+    reuse_cfg = mcts_cfg.model_copy(update={"tree_reuse": True})
+    mcts = BatchedMCTS(env, extractor, net.model, reuse_cfg, net.support)
+
+    states = jax.vmap(env.reset)(jax.random.split(jax.random.PRNGKey(3), 8))
+    carried = mcts.zero_carried(states)
+    _out, tree, _reused = mcts._search_carried(
+        net.variables, states, jax.random.PRNGKey(17), carried
+    )
+    planes = (
+        tree.e_visits, tree.e_value, tree.e_reward,
+        tree.children, tree.prior, tree.valid,
+    )
+    # Chosen actions: the visit argmax for most lanes, plus one lane
+    # forced onto a (likely) never-visited action to cover the
+    # invalid-promotion path.
+    counts = np.asarray(tree.e_visits[:, 0, :])
+    actions = counts.argmax(axis=1).astype(np.int32)
+    actions[0] = int(counts[0].argmin())
+    actions_d = jnp.asarray(actions)
+
+    failures = 0
+    for max_retained in (mcts.reuse_slots, 3):
+        ref_planes, ref_term, ref_sidx, ref_pv, ref_ret = eager_promote(
+            planes, tree.terminal, actions, max_retained
+        )
+        for mode in ("xla", "pallas"):
+            got = subtree_promote(
+                *planes, tree.terminal, actions_d,
+                max_retained=max_retained,
+                bfs_rounds=reuse_cfg.max_depth,
+                mode=mode,
+            )
+            names = (
+                "e_visits", "e_value", "e_reward", "children", "prior",
+                "valid", "terminal", "state_index", "promo_valid",
+                "retained",
+            )
+            refs = list(ref_planes) + [ref_term, ref_sidx, ref_pv, ref_ret]
+            for name, g, r in zip(names, got, refs):
+                if not np.array_equal(np.asarray(g), np.asarray(r)):
+                    print(
+                        f"reuse-smoke: {mode} promotion plane {name} "
+                        f"diverges from the eager reference "
+                        f"(max_retained={max_retained})",
+                        file=sys.stderr,
+                    )
+                    failures += 1
+    if failures:
+        return 1
+    print(
+        "reuse-smoke: promotion parity OK (xla+pallas vs eager NumPy "
+        f"reference, budgets {mcts.reuse_slots} and 3, one invalid lane)"
+    )
+    return 0
+
+
+def stage_speedup() -> int:
+    """Stage 2a: reuse ON >= SPEEDUP_BAR x leaf-evals/s at equal sims."""
+    from alphatriangle_tpu.rl.self_play import SelfPlayEngine
+
+    (_env_cfg, _model_cfg, mcts_cfg, train_cfg, env, extractor, net) = (
+        build_world()
+    )
+
+    def build_engine(reuse: bool) -> SelfPlayEngine:
+        cfg = mcts_cfg.model_copy(update={"tree_reuse": reuse})
+        engine = SelfPlayEngine(
+            env, extractor, net, cfg, train_cfg, seed=123
+        )
+        engine.play_chunk()  # compile + warm
+        engine.harvest()
+        return engine
+
+    fresh_eng = build_engine(False)
+    reuse_eng = build_engine(True)
+    # Interleave the two engines chunk by chunk and score each by its
+    # MEDIAN per-chunk time: on a shared CI box a transient load spike
+    # then taxes both sides (and the median discards it) instead of
+    # sinking whichever phase it happened to land on.
+    chunks = 8
+    fresh_times: list[float] = []
+    reuse_times: list[float] = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        fresh_eng.play_chunk()
+        fresh_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        reuse_eng.play_chunk()
+        reuse_times.append(time.perf_counter() - t0)
+    fresh_res = fresh_eng.harvest()
+    reuse_res = reuse_eng.harvest()
+    fresh_leafs = fresh_res.total_simulations + fresh_res.total_reused_visits
+    reuse_leafs = reuse_res.total_simulations + reuse_res.total_reused_visits
+    fresh_rate = (fresh_leafs / chunks) / float(np.median(fresh_times))
+    reuse_rate = (reuse_leafs / chunks) / float(np.median(reuse_times))
+    reuse_frac = reuse_res.total_reused_visits / max(1, reuse_leafs)
+    speedup = reuse_rate / fresh_rate
+    print(
+        f"reuse-smoke: leaf-evals/s fresh {fresh_rate:.0f} vs reuse "
+        f"{reuse_rate:.0f} -> {speedup:.2f}x "
+        f"(reused fraction {reuse_frac:.2f}; bar {SPEEDUP_BAR}x)"
+    )
+    if reuse_frac <= 0.0:
+        print("reuse-smoke: reuse never carried a visit", file=sys.stderr)
+        return 1
+    if speedup < SPEEDUP_BAR:
+        print(
+            f"reuse-smoke: speedup {speedup:.2f}x below the "
+            f"{SPEEDUP_BAR}x acceptance bar",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def stage_telemetry(root: str) -> int:
+    """Stage 2b: reuse run -> ledger util fields -> cli perf --json."""
+    from alphatriangle_tpu.cli import main as cli_main
+    from alphatriangle_tpu.config import PersistenceConfig, TrainConfig
+    from alphatriangle_tpu.training import run_training
+
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_world()
+    reuse_cfg = mcts_cfg.model_copy(update={"tree_reuse": True})
+    run_cfg = TrainConfig(
+        **{
+            **train_cfg.model_dump(),
+            "RUN_NAME": RUN_NAME,
+            "MAX_TRAINING_STEPS": 6,
+        }
+    )
+    pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=RUN_NAME)
+    rc = run_training(
+        train_config=run_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=reuse_cfg,
+        persistence_config=pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(
+            f"reuse-smoke: reuse training run failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+
+    ledger = pc.get_run_base_dir() / "metrics.jsonl"
+    utils = []
+    for line in ledger.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "util" and isinstance(
+            rec.get("leaf_evals_per_sec"), (int, float)
+        ):
+            utils.append(rec)
+    if not utils:
+        print(
+            f"reuse-smoke: {ledger} holds no util record with "
+            "leaf_evals_per_sec — the telemetry schema broke",
+            file=sys.stderr,
+        )
+        return 2
+    fracs = [
+        r.get("mcts_reused_visit_fraction")
+        for r in utils
+        if isinstance(r.get("mcts_reused_visit_fraction"), (int, float))
+    ]
+    if not fracs or max(fracs) <= 0.0:
+        print(
+            "reuse-smoke: ledger never recorded a positive "
+            f"mcts_reused_visit_fraction (got {fracs})",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"reuse-smoke: {len(utils)} ledger util record(s); peak reused "
+        f"fraction {max(fracs):.2f}"
+    )
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["perf", RUN_NAME, "--root-dir", root, "--json"])
+    if rc != 0:
+        print(f"reuse-smoke: cli perf failed (rc={rc})", file=sys.stderr)
+        return rc
+    summary = json.loads(buf.getvalue())
+    for key in ("leaf_evals_per_sec", "mcts_reused_visit_fraction"):
+        if not isinstance(summary.get(key), (int, float)):
+            print(
+                f"reuse-smoke: cli perf --json lacks {key}",
+                file=sys.stderr,
+            )
+            return 2
+    if summary["mcts_reused_visit_fraction"] <= 0.0:
+        print(
+            "reuse-smoke: cli perf --json reused fraction is zero",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        "reuse-smoke: cli perf --json leaf-evals/s "
+        f"{summary['leaf_evals_per_sec']:.0f}, reused fraction "
+        f"{summary['mcts_reused_visit_fraction']:.2f}"
+    )
+    return 0
+
+
+def stage_arena() -> int:
+    """Stage 3: fixed-seed paired arena — reuse at REDUCED_SIMS must be
+    score-neutral-or-better vs fresh-root at FULL_SIMS, both through
+    the PolicyService dispatch path (arena.play_service)."""
+    from alphatriangle_tpu.arena import play_service
+    from alphatriangle_tpu.mcts.search import BatchedMCTS
+    from alphatriangle_tpu.serving.service import PolicyService
+
+    (_env_cfg, _model_cfg, mcts_cfg, _train_cfg, env, extractor, net) = (
+        build_world()
+    )
+
+    def arena_mean(sims: int, reuse: bool) -> float:
+        cfg = mcts_cfg.model_copy(
+            update={"max_simulations": sims, "tree_reuse": reuse}
+        )
+        mcts = BatchedMCTS(env, extractor, net.model, cfg, net.support)
+        service = PolicyService(
+            env, extractor, net, mcts, slots=ARENA_GAMES
+        )
+        scores, _lengths, _done = play_service(
+            service, ARENA_GAMES, ARENA_MAX_MOVES, seed=11
+        )
+        return float(np.mean(scores))
+
+    fresh = arena_mean(FULL_SIMS, reuse=False)
+    reduced = arena_mean(REDUCED_SIMS, reuse=True)
+    print(
+        f"reuse-smoke: arena mean score fresh@{FULL_SIMS} {fresh:.3f} "
+        f"vs reuse@{REDUCED_SIMS} {reduced:.3f} "
+        f"({ARENA_GAMES} paired hands, seed 11)"
+    )
+    if reduced < fresh:
+        print(
+            "reuse-smoke: reuse at reduced sims LOST strength "
+            f"({reduced:.3f} < {fresh:.3f})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--root-dir",
+        default=None,
+        help="Runs root for the telemetry stage (default: a temp dir).",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    rc = stage_parity()
+    if rc != 0:
+        return rc
+    rc = stage_speedup()
+    if rc != 0:
+        return rc
+    root = args.root_dir or tempfile.mkdtemp(prefix="at_reuse_smoke_")
+    try:
+        rc = stage_telemetry(root)
+    finally:
+        if args.root_dir is None:
+            shutil.rmtree(root, ignore_errors=True)
+    if rc != 0:
+        return rc
+    rc = stage_arena()
+    if rc != 0:
+        return rc
+    print("reuse-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,7 @@ class TestProgramFamily:
         assert program_family("learner_fused_from_sharded_ring/s2_dp") == "learner"
         assert program_family("megastep/dp2_t4_k2") == "megastep"
         assert program_family("serve/b64") == "serve"
+        assert program_family("reuse/promote_b64") == "reuse"
         assert program_family("warm/xyz") == "warm"
 
 

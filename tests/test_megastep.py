@@ -219,6 +219,38 @@ class TestMegastepLoop:
         c.checkpoints.close()
 
     @pytest.mark.slow
+    def test_one_dispatch_holds_with_tree_reuse(
+        self, tmp_path, tiny_world_configs, monkeypatch
+    ):
+        """Subtree reuse rides INSIDE the fused program: with
+        tree_reuse on, steady state is still exactly one device
+        dispatch per iteration (the promotion never becomes its own
+        dispatch) and the loop's reused-visit counter proves the
+        carried trees were actually consumed. Marked slow (a second
+        full megastep compile); the in-program reuse accumulation is
+        tier-1-covered at engine level and the dispatch accounting by
+        the fresh-root one-dispatch test above."""
+        monkeypatch.setenv("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
+        c = build(
+            tmp_path,
+            tiny_world_configs,
+            run_name="mega_reuse",
+            MAX_TRAINING_STEPS=4,
+            ROLLOUT_CHUNK_MOVES=2,
+            mcts_kw={"tree_reuse": True},
+        )
+        loop = TrainingLoop(c)
+        status = loop.run()
+        assert status == LoopStatus.COMPLETED
+        runner = c.megastep
+        assert loop.megastep_iterations > 0
+        assert runner.dispatch_count == loop.megastep_iterations
+        assert c.trainer.dispatch_count == 0
+        assert loop.total_reused_visits > 0
+        c.stats.close()
+        c.checkpoints.close()
+
+    @pytest.mark.slow
     def test_counters_contract_matches_sync(
         self, tmp_path, tiny_world_configs
     ):

@@ -10,7 +10,12 @@ import numpy as np
 import pytest
 
 from alphatriangle_tpu.mcts import BatchedMCTS
-from alphatriangle_tpu.ops import backup_update, gather_rows, per_sample
+from alphatriangle_tpu.ops import (
+    backup_update,
+    gather_rows,
+    per_sample,
+    subtree_promote,
+)
 
 
 class TestGatherRows:
@@ -173,6 +178,134 @@ class TestBackupUpdate:
         ops = _backup_operands()
         with pytest.raises(ValueError, match="unknown backup"):
             backup_update(*ops.values(), mode="x")
+
+
+def _promote_operands(seed=0, batch=3, nodes=8, actions=3):
+    """Random forest planes with hand-known topology on lane 0/1 plus a
+    random-ish lane: children form a proper forest (each node at most
+    one parent, ids increasing away from the root) so the scatter-min
+    BFS in `_promotion_plan` and a literal traversal must agree."""
+    rng = np.random.default_rng(seed)
+    ch = np.full((batch, nodes, actions), -1.0, np.float32)
+    # lane 0: 0 -> {1, 2}, 1 -> {3}, 2 -> {4}; action 0 promotes node 1.
+    ch[0, 0, 0], ch[0, 0, 1] = 1.0, 2.0
+    ch[0, 1, 0] = 3.0
+    ch[0, 2, 1] = 4.0
+    # lane 1: chosen action has no child (invalid promotion).
+    ch[1, 0, 0] = 5.0
+    # lane 2: a deeper chain 0 -> 1 -> 2 -> 3 under action 0.
+    ch[2, 0, 0] = 1.0
+    ch[2, 1, 1] = 2.0
+    ch[2, 2, 0] = 3.0
+    planes = tuple(
+        rng.random((batch, nodes, actions)).astype(np.float32)
+        for _ in range(3)
+    ) + (ch,) + tuple(
+        rng.random((batch, nodes, actions)).astype(np.float32)
+        for _ in range(2)
+    )
+    terminal = rng.random((batch, nodes)) < 0.3
+    acts = np.array([0, 1, 0], np.int32)
+    return planes, terminal, acts
+
+
+def _eager_promote(planes, terminal, actions, max_retained):
+    """Literal-BFS reference for `subtree_promote` (mirrors the
+    reuse-smoke reference): traverse from the chosen child, order
+    (depth, node id), truncate at the budget, remap children, zero
+    freed rows, broadcast the chosen child over freed state_index."""
+    from collections import deque
+
+    ev, eq, er, ch, pr, va = [np.asarray(p, np.float32) for p in planes]
+    term = np.asarray(terminal, bool)
+    b_n, n, a_dim = ev.shape
+    outs = [np.zeros_like(p) for p in (ev, eq, er, ch, pr, va)]
+    outs[3][:] = -1.0
+    term_out = np.zeros_like(term)
+    state_index = np.zeros((b_n, n), np.int32)
+    promo_valid = np.zeros(b_n, bool)
+    retained = np.zeros(b_n, np.int32)
+    for b in range(b_n):
+        c0 = int(ch[b, 0, actions[b]])
+        if c0 < 0:
+            continue
+        promo_valid[b] = True
+        depth = {c0: 0}
+        dq = deque([c0])
+        while dq:
+            u = dq.popleft()
+            for act in range(a_dim):
+                v = int(ch[b, u, act])
+                if v >= 0 and v not in depth:
+                    depth[v] = depth[u] + 1
+                    dq.append(v)
+        order = sorted(depth, key=lambda u: (depth[u], u))
+        rank = {u: r for r, u in enumerate(order)}
+        ret = min(len(order), max_retained)
+        retained[b] = ret
+        for r, u in enumerate(order[:ret]):
+            for i, plane in enumerate((ev, eq, er, None, pr, va)):
+                if plane is not None:
+                    outs[i][b, r] = plane[b, u]
+            for act in range(a_dim):
+                v = int(ch[b, u, act])
+                kept = v >= 0 and v in rank and rank[v] < max_retained
+                outs[3][b, r, act] = float(rank[v]) if kept else -1.0
+            term_out[b, r] = term[b, u]
+        state_index[b, :ret] = order[:ret]
+        state_index[b, ret:] = c0
+    return outs, term_out, state_index, promo_valid, retained
+
+
+class TestSubtreePromote:
+    """Root promotion for subtree reuse (docs/KERNELS.md): both
+    lowerings against a literal-BFS numpy reference, including budget
+    truncation and the invalid-promotion lane."""
+
+    @pytest.mark.parametrize("mode", ["xla", "pallas"])
+    @pytest.mark.parametrize("max_retained", [8, 3])
+    def test_matches_eager_reference(self, mode, max_retained):
+        planes, terminal, acts = _promote_operands()
+        ref_planes, ref_term, ref_sidx, ref_pv, ref_ret = _eager_promote(
+            planes, terminal, acts, max_retained
+        )
+        got = subtree_promote(
+            *[jnp.asarray(p) for p in planes],
+            jnp.asarray(terminal),
+            jnp.asarray(acts),
+            max_retained=max_retained,
+            bfs_rounds=4,
+            mode=mode,
+        )
+        refs = list(ref_planes) + [ref_term, ref_sidx, ref_pv, ref_ret]
+        for g, want in zip(got, refs):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
+
+    def test_pallas_matches_xla_exactly(self):
+        planes, terminal, acts = _promote_operands(seed=9)
+        kw = dict(max_retained=5, bfs_rounds=4)
+        out_x = subtree_promote(
+            *[jnp.asarray(p) for p in planes],
+            jnp.asarray(terminal), jnp.asarray(acts), mode="xla", **kw
+        )
+        out_p = subtree_promote(
+            *[jnp.asarray(p) for p in planes],
+            jnp.asarray(terminal), jnp.asarray(acts), mode="pallas", **kw
+        )
+        for g, want in zip(out_p, out_x):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
+
+    def test_unknown_mode_raises(self):
+        planes, terminal, acts = _promote_operands()
+        with pytest.raises(ValueError, match="unknown subtree_promote"):
+            subtree_promote(
+                *[jnp.asarray(p) for p in planes],
+                jnp.asarray(terminal),
+                jnp.asarray(acts),
+                max_retained=4,
+                bfs_rounds=4,
+                mode="x",
+            )
 
 
 class TestSearchGatherInvariance:

@@ -151,6 +151,36 @@ class TestPolicyService:
         )
         assert solo == crowded
 
+    def test_lane_isolation_holds_with_tree_reuse(self, serve_world):
+        """Subtree reuse carries per-lane trees across dispatches
+        (docs/KERNELS.md): a slot-0 session must still play the exact
+        same game solo vs inside a churning crowd — admits/retires
+        invalidate ONLY their own lanes' carried trees — and the
+        carried visits must actually register on the reuse counter."""
+        from alphatriangle_tpu.config import AlphaTriangleMCTSConfig
+
+        env, fe, net, _mcts = serve_world
+        # 8 sims / depth 4 (vs the fixture's 4/3): the promoted child
+        # needs expanded edges of its own before the reuse counter can
+        # register carried visits.
+        reuse_cfg = AlphaTriangleMCTSConfig(
+            max_simulations=8, max_depth=4, mcts_batch_size=4,
+            tree_reuse=True,
+        )
+        mcts = BatchedMCTS(env, fe, net.model, reuse_cfg, net.support)
+        reset_key = jax.random.PRNGKey(42)
+        dispatch_keys = [jax.random.PRNGKey(100 + i) for i in range(10)]
+        solo_service = PolicyService(env, fe, net, mcts, slots=SLOTS)
+        solo = drive_session(
+            solo_service, reset_key, dispatch_keys, churn=False
+        )
+        crowded = drive_session(
+            PolicyService(env, fe, net, mcts, slots=SLOTS),
+            reset_key, dispatch_keys, churn=True,
+        )
+        assert solo == crowded
+        assert solo_service.reused_visits_total > 0
+
     def test_dispatch_serves_queue_and_reports_latency(self, serve_world):
         service = make_service(serve_world)
         sessions = service.open_sessions(
