@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke reuse-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke reuse-smoke devstats-smoke
 
 check: lint type test
 
@@ -144,6 +144,18 @@ ops-smoke:
 # at full sims.
 reuse-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/reuse_smoke.py
+
+# Device-telemetry gate (docs/OBSERVABILITY.md "Device telemetry
+# plane"): a short megastep CPU run with stat-packs on must land
+# `kind:"device_stats"` ledger records surfaced as ds_* fields by
+# `cli perf --json` while the one-dispatch gauge still reads 1.0;
+# stat-packs timed OFF vs ON on the same megastep shape must cost <3%
+# wall (they ride the existing fetch — no extra dispatches); and a
+# beacon-armed child with an injected dispatch hang must die by the
+# watchdog's 113 leaving beacons.jsonl + a wedge report whose frozen
+# last_beacon the jax-blocked `cli doctor` verdict names.
+devstats-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/devstats_smoke.py
 
 # Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
 # under a host-RAM byte limit must emit a tuned_preset.json that

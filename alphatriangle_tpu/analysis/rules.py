@@ -224,6 +224,24 @@ class HostSyncInHotPath(Rule):
                     "reason; otherwise batch it",
                 )
                 continue
+            # `jax.debug.callback` is the SANCTIONED beacon channel
+            # (telemetry/device_stats.py emit_beacon): unordered,
+            # non-blocking, fire-and-forget — NOT a host sync; no
+            # finding. `io_callback` is different: ordered=True (or a
+            # result that feeds the program) serializes the device on
+            # the host round-trip — that IS a hot-path sync.
+            if name in ("jax.experimental.io_callback", "io_callback"):
+                yield _finding(
+                    self,
+                    mod,
+                    node,
+                    f"{name} in a hot module blocks the device program "
+                    "on a host round-trip — for progress beacons use "
+                    "jax.debug.callback(..., ordered=False) "
+                    "(telemetry/device_stats.py emit_beacon); keep "
+                    "io_callback off the dispatch path",
+                )
+                continue
             if name in _NP_FETCH:
                 parent = mod.parents.get(node)
                 if (

@@ -740,6 +740,16 @@ def cmd_perf(args: argparse.Namespace) -> int:
     )
     if fleet is not None:
         summary.update(fleet)
+    # Device-stats fold (telemetry/device_stats.py `kind:"device_stats"`
+    # records — the in-program stat-packs): ds_* fields + the line
+    # below. None on legacy/stats-off ledgers, zero new output then.
+    from .telemetry.device_stats import summarize_device_stats
+
+    devstats = summarize_device_stats(
+        read_ledger(ledger, kinds={"device_stats"})
+    )
+    if devstats is not None:
+        summary.update(devstats)
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -794,6 +804,28 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   limit {_fmt_bytes(summary.get('mem_bytes_limit'))}"
             f"   est budget {_fmt_bytes(mem_budget)} (cli mem)"
         )
+    if devstats is not None:
+        # In-program search health (device-stats plane): entropy/
+        # occupancy are window means, value/occupancy maxes are
+        # run-wide excursions.
+        print(
+            f"  search       entropy {_fmt_cell(summary.get('ds_root_entropy'), ',.2f')}"
+            f" (min {_fmt_cell(summary.get('ds_root_entropy_min'), ',.2f')})"
+            f"   |v|max {_fmt_cell(summary.get('ds_value_abs_max'), ',.2f')}"
+            f"   occupancy {_fmt_cell(summary.get('ds_tree_occupancy'), ',.0f', 100.0, '%')}"
+            f" (max {_fmt_cell(summary.get('ds_tree_occupancy_max'), ',.0f', 100.0, '%')})"
+            f"   reuse {_fmt_cell(summary.get('ds_reuse_frac'), ',.0f', 100.0, '%')}"
+            f"   records {_fmt_cell(summary.get('ds_records'), ',.0f')}"
+        )
+        if summary.get("ds_grad_norm_max") is not None or summary.get(
+            "ds_priority_skew"
+        ) is not None:
+            print(
+                f"  ingest/per   priority skew {_fmt_cell(summary.get('ds_priority_skew'), ',.1f')}"
+                f"   IS w min {_fmt_cell(summary.get('ds_is_weight_min'), ',.3f')}"
+                f"   grad max {_fmt_cell(summary.get('ds_grad_norm_max'), ',.2f')}"
+                f"   update max {_fmt_cell(summary.get('ds_update_norm_max'), ',.3f')}"
+            )
     if summary.get("serve_move_latency_ms_p95") is not None:
         # Policy-service SLO line (serving/service.py; docs/SERVING.md):
         # p50 averages tick windows, p95 is the WORST window.
@@ -2361,8 +2393,19 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     preempt = read_preempt_report(run_dir / PREEMPT_REPORT_FILENAME)
     ledger = resolve_ledger_path(run_dir)
     utils = read_ledger(ledger, kinds={"util"}) if ledger else []
+    # Progress-beacon forensics (telemetry/device_stats.py): the newest
+    # beacons.jsonl row names the phase a hung program last announced.
+    # Missing file (legacy run / never armed) -> None, zero new output.
+    from .telemetry.device_stats import describe_beacon, last_beacon
+
+    beacon = last_beacon(run_dir)
     verdict = classify_run(
-        flight, health=health, utils=utils, wedge=wedge, preempt=preempt
+        flight,
+        health=health,
+        utils=utils,
+        wedge=wedge,
+        preempt=preempt,
+        beacon=beacon,
     )
     if args.json:
         verdict["run_dir"] = str(run_dir)
@@ -2380,6 +2423,8 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     )
     if verdict.get("detail"):
         print(f"  detail    {verdict['detail']}")
+    if verdict.get("last_beacon"):
+        print(f"  beacon    {describe_beacon(verdict['last_beacon'])}")
     print(
         f"  evidence  {ev['intents']} intents, {ev['seals']} seals, "
         f"{ev['unsealed']} unsealed"

@@ -185,7 +185,12 @@ class CompileCache:
         return self.tracer.span(name, **args)
 
     def wrap(
-        self, name: str, jit_fn, extra: str = "", cpu_aot: bool = True
+        self,
+        name: str,
+        jit_fn,
+        extra: str = "",
+        cpu_aot: bool = True,
+        serialize: bool = True,
     ) -> "CachedProgram":
         """Wrap a jitted function in an AOT-caching dispatcher.
 
@@ -197,8 +202,16 @@ class CompileCache:
         this image — the reloaded executable runs without error and
         returns the donated train state UNCHANGED (params silently stop
         updating; reproduced deterministically, see rl/trainer.py).
-        Accelerator backends are unaffected by the flag."""
-        return CachedProgram(self, name, jit_fn, extra=extra, cpu_aot=cpu_aot)
+        Accelerator backends are unaffected by the flag.
+        `serialize=False` keeps the in-memory AOT path (lower+compile
+        once per signature) but never reads or writes executable
+        artifacts on ANY backend — for programs whose executables are
+        not round-trippable, e.g. beacon-armed programs embedding
+        `jax.debug.callback` closures (telemetry/device_stats.py)."""
+        return CachedProgram(
+            self, name, jit_fn, extra=extra, cpu_aot=cpu_aot,
+            serialize=serialize,
+        )
 
     # --- keying -----------------------------------------------------------
 
@@ -302,12 +315,16 @@ class CompileCache:
 
     # --- load / compile / serialize ---------------------------------------
 
-    def load_or_compile(self, name: str, key: str, jit_fn, args):
+    def load_or_compile(
+        self, name: str, key: str, jit_fn, args, serialize: bool = True
+    ):
         """Deserialize a cached executable for `key`, or compile fresh
         (serializing the result). Returns a `jax.stages.Compiled`, or
-        `_FALLBACK` when neither path is viable."""
+        `_FALLBACK` when neither path is viable. `serialize=False`
+        skips BOTH artifact directions (no deserialize, no serialize):
+        the executable lives only in this process."""
         path = self._path(name, key)
-        if path.exists():
+        if serialize and path.exists():
             t0 = time.time()
             try:
                 with self._span(f"compile/{name}", event="deserialize"):
@@ -362,7 +379,8 @@ class CompileCache:
         self._note("miss", name, dt)
         logger.info("compile_cache: %s MISS (compiled in %.2fs)", name, dt)
         self.capture_memory(name, key, compiled)
-        self._serialize(name, path, compiled)
+        if serialize:
+            self._serialize(name, path, compiled)
         return compiled
 
     def _serialize(self, name: str, path: Path, compiled) -> None:
@@ -465,12 +483,14 @@ class CachedProgram:
         jit_fn,
         extra: str = "",
         cpu_aot: bool = True,
+        serialize: bool = True,
     ) -> None:
         self._cache = cache
         self.name = name
         self._jit_fn = jit_fn
         self._extra = extra
         self._cpu_aot = cpu_aot
+        self._serialize_artifacts = serialize
         self._execs: dict[str, object] = {}
         self._lock = threading.Lock()
 
@@ -491,7 +511,11 @@ class CachedProgram:
                 exe = self._execs.get(key)
                 if exe is None:
                     exe = self._cache.load_or_compile(
-                        self.name, key, self._jit_fn, args
+                        self.name,
+                        key,
+                        self._jit_fn,
+                        args,
+                        serialize=self._serialize_artifacts,
                     )
                     self._execs[key] = exe
         return key, exe

@@ -78,6 +78,26 @@ class TelemetryConfig(BaseModel):
     # observe the report without dying.
     DISPATCH_EXIT_ON_WEDGE: bool = Field(default=True)
 
+    # --- device telemetry plane (telemetry/device_stats.py) ---
+    # Fixed-shape in-program stat-packs (KataGo-style search health:
+    # root-visit entropy/concentration, value bounds, tree occupancy;
+    # PER skew; per-fused-step grad/update norms) computed inside the
+    # hot programs and returned through the EXISTING single
+    # per-iteration fetch — no extra dispatch, no host sync. Ledgered
+    # as kind:"device_stats" records (`cli perf`, `cli watch`,
+    # bench.py) and fed to AnomalyDetector.observe_search.
+    DEVICE_STATS: bool = Field(default=True)
+    # Progress beacons (`jax.debug.callback` phase markers appended to
+    # runs/<run>/beacons.jsonl) are OFF on hot paths by default; they
+    # arm via ALPHATRIANGLE_BEACONS=1, the dispatch watchdog's
+    # near-deadline warning, or a supervised dispatch-hung respawn.
+    # When armed, search-wave beacons subsample to every Nth wave.
+    BEACON_EVERY_N_WAVES: int = Field(default=8, ge=1)
+    # Fraction of the dispatch deadline after which the watchdog warns
+    # and arms beacons for programs built from then on (the wedge's
+    # SECOND occurrence then names its phase).
+    DISPATCH_WARN_FRACTION: float = Field(default=0.5, gt=0, lt=1.0)
+
     # --- anomaly detection ---
     ANOMALY_ENABLED: bool = Field(default=True)
     ANOMALY_EWMA_ALPHA: float = Field(default=0.02, gt=0, le=1.0)

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config.mcts_config import MCTSConfig
+from ..telemetry.device_stats import beacon_every, emit_beacon
 from .search import BatchedMCTS, SearchOutput
 
 
@@ -155,15 +156,21 @@ class GumbelMCTS(BatchedMCTS):
             return cand_mask & (score >= kth)
 
         def wave_body(k, carry):
-            tree, wasted, base, cand_mask = carry
-            roots = assign_roots(tree, cand_mask)
-            tree, wasted, base = self._wave(
+            # The search carry is (tree, wasted, base) plus the
+            # device-stats histogram tail when enabled (`_stats_seed`);
+            # the candidate mask rides behind it and never enters
+            # `_wave`.
+            *sc, cand_mask = carry
+            emit_beacon("search_wave", k, every=beacon_every())
+            roots = assign_roots(sc[0], cand_mask)
+            sc = self._wave(
                 variables,
                 batch,
-                (tree, wasted, base),
+                tuple(sc),
                 jax.random.fold_in(wave_rng, k),
                 root_action=roots,
             )
+            tree = sc[0]
             # Halve after every wave but the last (the final set is
             # resolved by argmax below).
             cand_mask = jax.lax.cond(
@@ -171,14 +178,18 @@ class GumbelMCTS(BatchedMCTS):
                 lambda: halve(tree, cand_mask),
                 lambda: cand_mask,
             )
-            return tree, wasted, base, cand_mask
+            return (*sc, cand_mask)
 
-        tree, wasted, _, cand = jax.lax.fori_loop(
+        final = jax.lax.fori_loop(
             0,
             self.num_waves,
             wave_body,
-            (tree, jnp.zeros((batch,), jnp.int32), jnp.int32(1), cand),
+            (tree, jnp.zeros((batch,), jnp.int32), jnp.int32(1))
+            + self._stats_seed()
+            + (cand,),
         )
+        tree, wasted, base = final[0], final[1], final[2]
+        stats_tail, cand = final[3:-1], final[-1]
 
         q, visits = self._root_q(tree)
         final_score = jnp.where(
@@ -207,6 +218,9 @@ class GumbelMCTS(BatchedMCTS):
         root_value = (
             tree.root_value0 + tree.e_value[:, 0, :].sum(axis=-1)
         ) / root_visits
+        stats = None
+        if self.device_stats:
+            stats = self._stat_pack(tree, wasted, base, stats_tail[0], batch)
         return SearchOutput(
             visit_counts=visits,
             root_value=root_value,
@@ -215,4 +229,5 @@ class GumbelMCTS(BatchedMCTS):
             wasted_slots=wasted,
             selected_action=selected,
             improved_policy=improved,
+            stats=stats,
         )

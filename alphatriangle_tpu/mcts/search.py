@@ -63,6 +63,12 @@ from ..config.mcts_config import MCTSConfig
 from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
 from ..ops import backup_update, gather_rows, subtree_promote
+from ..telemetry.device_stats import (
+    DEPTH_BINS,
+    beacon_every,
+    device_stats_enabled,
+    emit_beacon,
+)
 
 
 @struct.dataclass
@@ -114,6 +120,15 @@ class SearchOutput:
     # improved_policy zeros = "build the target from visit counts".
     selected_action: jax.Array  # (B,) int32
     improved_policy: jax.Array  # (B, A) float32
+    # Device telemetry stat-pack (telemetry/device_stats.py): a small
+    # dict of fixed-shape f32 search-health statistics (leaf-depth
+    # histogram, root-visit entropy/concentration, max |value|, slot
+    # occupancy, reuse retained-fraction), or None when
+    # TelemetryConfig.DEVICE_STATS is off — it rides the caller's
+    # existing fetch, costing zero extra dispatches. Both search kinds
+    # (PUCT and Gumbel) produce the same structure so the playout-cap
+    # lax.cond branches keep matching pytrees.
+    stats: Any = None
 
 
 class BatchedMCTS:
@@ -156,6 +171,11 @@ class BatchedMCTS:
             w -= 1
         self.wave_size = w
         self.num_waves = config.max_simulations // w
+        # Snapshot of the device-stats flag at construction: it shapes
+        # the compiled programs (SearchOutput.stats leaf), so engines
+        # fold it into their AOT cache extras and never flip it on a
+        # live instance.
+        self.device_stats = device_stats_enabled()
         self.search = jax.jit(self._search)
 
     # --- network evaluation ----------------------------------------------
@@ -365,9 +385,15 @@ class BatchedMCTS:
         }
 
     def _wave(self, variables, batch: int, carry, wave_rng, root_action=None):
-        """One wave: W parallel sims across all B trees."""
+        """One wave: W parallel sims across all B trees.
+
+        `carry` is `(tree, wasted, base)` plus — when `device_stats` is
+        on — a trailing `(DEPTH_BINS,) f32` leaf-depth histogram the
+        wave accumulates into; the return matches the input arity.
+        """
         cfg = self.config
-        tree, wasted, base = carry
+        tree, wasted, base = carry[:3]
+        hist = carry[3] if self.device_stats and len(carry) > 3 else None
         w, a = self.wave_size, self.action_dim
         depth = cfg.max_depth
         barange = jnp.arange(batch)
@@ -448,6 +474,15 @@ class BatchedMCTS:
         rec_node, rec_action = d["rec_node"], d["rec_action"]
         rec_active = d["rec_active"]  # (B, W, D)
         last_idx = rec_active.sum(axis=-1) - 1  # (B, W) deepest level
+        if hist is not None:
+            # Leaf-depth histogram: one count per simulation at its
+            # descent depth (terminal-root sims land in bin 0; depths
+            # past the last bin clip into it). A (B*W, BINS) one-hot
+            # sum — vector math on data already in registers.
+            d_bin = jnp.clip(last_idx, 0, DEPTH_BINS - 1).reshape(-1)
+            hist = hist + jax.nn.one_hot(
+                d_bin, DEPTH_BINS, dtype=jnp.float32
+            ).sum(axis=0)
         g = leaf_values  # (B, W)
         contrib = []
         for lvl in range(depth - 1, -1, -1):
@@ -484,18 +519,30 @@ class BatchedMCTS:
         )
 
         wasted = wasted + (w - live.sum(axis=1, dtype=jnp.int32))
+        if hist is not None:
+            return tree, wasted, base + w, hist
         return tree, wasted, base + w
+
+    def _stats_seed(self) -> tuple:
+        """The extra carry tail `_wave` accumulates when device stats
+        are on: a zeroed leaf-depth histogram. Empty tuple when off, so
+        unchanged configs carry exactly the original 3-tuple."""
+        if not self.device_stats:
+            return ()
+        return (jnp.zeros((DEPTH_BINS,), jnp.float32),)
 
     def _run_waves(self, variables, batch: int, tree: Tree, wave_rng, base0):
         """`num_waves` waves from `tree`; `base0` is the first insertion
-        base — scalar 1 (fresh root) or a per-game (B,) vector (reuse)."""
+        base — scalar 1 (fresh root) or a per-game (B,) vector (reuse).
+        Returns `(tree, wasted, base)` plus the depth histogram when
+        device stats are on (`_stats_seed`)."""
 
         def wave_body(k, carry):
-            tree, wasted, base = carry
+            emit_beacon("search_wave", k, every=beacon_every())
             return self._wave(
                 variables,
                 batch,
-                (tree, wasted, base),
+                carry,
                 jax.random.fold_in(wave_rng, k),
             )
 
@@ -503,8 +550,62 @@ class BatchedMCTS:
             0,
             self.num_waves,
             wave_body,
-            (tree, jnp.zeros((batch,), jnp.int32), base0),
+            (tree, jnp.zeros((batch,), jnp.int32), base0)
+            + self._stats_seed(),
         )
+
+    def _stat_pack(
+        self,
+        tree: Tree,
+        wasted: jax.Array,
+        final_base,
+        hist: jax.Array,
+        batch: int,
+        reused: jax.Array | None = None,
+    ) -> dict:
+        """KataGo-style search-health statistics (arXiv:1902.10565)
+        from arrays already on device — a handful of (B, A)-sized
+        reductions appended to the program, returned through the
+        caller's existing fetch.
+
+        All leaves are fixed-shape f32 scalars except `depth_hist`
+        ((DEPTH_BINS,)); the structure is identical across search kinds
+        and reuse modes so downstream pytrees always match."""
+        visits = tree.e_visits[:, 0, :]  # (B, A) root edge visits
+        total = visits.sum(axis=-1)  # (B,)
+        p = visits / jnp.maximum(total[:, None], 1.0)
+        entropy = -jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-12)), 0.0).sum(
+            axis=-1
+        )
+        # Mean |Q| excursion over visited root edges, and the root
+        # value itself: a diverging value head shows up here waves
+        # before it poisons the iteration-mean loss metrics.
+        q_abs = jnp.where(
+            visits > 0,
+            jnp.abs(tree.e_value[:, 0, :]) / jnp.maximum(visits, 1e-9),
+            0.0,
+        )
+        value_abs_max = jnp.maximum(
+            q_abs.max(), jnp.abs(tree.root_value0).max()
+        )
+        live = (
+            jnp.broadcast_to(
+                jnp.asarray(final_base, jnp.float32), (batch,)
+            )
+            - wasted.astype(jnp.float32)
+        )
+        if reused is None:
+            reuse_frac = jnp.float32(0.0)
+        else:
+            reuse_frac = (reused / jnp.maximum(total, 1.0)).mean()
+        return {
+            "depth_hist": hist,
+            "root_entropy": entropy.mean(),
+            "root_concentration": p.max(axis=-1).mean(),
+            "value_abs_max": value_abs_max,
+            "occupancy": (live / float(self.num_nodes)).mean(),
+            "reuse_frac": reuse_frac,
+        }
 
     def _output_from_tree(
         self, tree: Tree, wasted: jax.Array, batch: int
@@ -533,10 +634,15 @@ class BatchedMCTS:
         batch = root_states.done.shape[0]
         rng, noise_rng, wave_rng = jax.random.split(rng, 3)
         tree = self._init_tree(variables, root_states, noise_rng)
-        tree, wasted, _ = self._run_waves(
+        tree, wasted, base, *rest = self._run_waves(
             variables, batch, tree, wave_rng, jnp.int32(1)
         )
-        return self._output_from_tree(tree, wasted, batch)
+        out = self._output_from_tree(tree, wasted, batch)
+        if self.device_stats:
+            out = out.replace(
+                stats=self._stat_pack(tree, wasted, base, rest[0], batch)
+            )
+        return out
 
     # --- subtree reuse (MCTSConfig.tree_reuse; ops/subtree_reuse.py) ---
 
@@ -598,10 +704,17 @@ class BatchedMCTS:
         base0 = jnp.where(ok, jnp.maximum(carried.base, 1), 1).astype(
             jnp.int32
         )
-        tree, wasted, _ = self._run_waves(
+        tree, wasted, base, *rest = self._run_waves(
             variables, batch, tree, wave_rng, base0
         )
-        return self._output_from_tree(tree, wasted, batch), tree, reused
+        out = self._output_from_tree(tree, wasted, batch)
+        if self.device_stats:
+            out = out.replace(
+                stats=self._stat_pack(
+                    tree, wasted, base, rest[0], batch, reused=reused
+                )
+            )
+        return out, tree, reused
 
     def promote(self, tree: Tree, actions: jax.Array) -> CarriedTree:
         """Batched root promotion: compact each game's chosen child's

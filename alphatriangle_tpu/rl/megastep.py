@@ -102,6 +102,15 @@ from ..compile_cache import config_digest, get_compile_cache
 from ..config.train_config import TrainConfig
 from ..nn.precision import cast_params_for_inference
 from ..ops import per_sample
+from ..telemetry.device_stats import (
+    beacon_signature,
+    beacons_armed,
+    device_stats_signature,
+    emit_beacon,
+    fold_search_stats,
+    note_dispatch,
+    rollout_chunk_stats,
+)
 from ..telemetry.flight import flight_span
 from .device_buffer import DeviceReplayBuffer, ring_scatter
 
@@ -188,13 +197,25 @@ class MegastepRunner:
         # cached. cpu_aot=False: the program donates + updates the train
         # state, the exact family whose XLA:CPU deserialization silently
         # returns donated state unchanged (rl/trainer.py).
-        extra = config_digest(
-            engine.mcts_config,
-            train_config,
-            trainer.nn.model_config,
-            engine.env.cfg,
-        ) + (
-            f"|att{int(getattr(trainer.nn.model, 'attention_fn', None) is not None)}"
+        # Device telemetry plane (telemetry/device_stats.py): the
+        # stat-pack flag rides the engine's searches (snapshotted at
+        # engine construction) and adds output leaves; beacons embed
+        # host callbacks. Both shape the program, so both join the
+        # cache extra, and beacon-armed executables skip serialization.
+        self.device_stats = bool(getattr(engine, "device_stats", False))
+        self.last_device_stats: "dict | None" = None
+        extra = (
+            config_digest(
+                engine.mcts_config,
+                train_config,
+                trainer.nn.model_config,
+                engine.env.cfg,
+            )
+            + (
+                f"|att{int(getattr(trainer.nn.model, 'attention_fn', None) is not None)}"
+            )
+            + device_stats_signature()
+            + beacon_signature()
         )
         impl = self._sharded_impl if self.sharded else self._impl
         name = (
@@ -212,6 +233,7 @@ class MegastepRunner:
                 ),
                 extra=extra,
                 cpu_aot=False,
+                serialize=not beacons_armed(),
             )
         )
         # Observability: program dispatches (the loop's one-dispatch-
@@ -270,6 +292,21 @@ class MegastepRunner:
             weights = jnp.ones((k, b), jnp.float32)
         return state, idx, weights
 
+    def _per_stat_pack(self, priorities, weights) -> dict:
+        """Ingest/PER stat leg of the device stat-pack: priority-mass
+        skew (max over mean of the live slots — empty and trash slots
+        sit at exactly 0 so the mask is free) and the IS-weight
+        extremes of the K sampled batches. Pure reductions over arrays
+        already in the program; rides the one fetch."""
+        live = priorities
+        count = jnp.maximum((live > 0).sum(), 1).astype(jnp.float32)
+        mean_live = jnp.maximum(live.sum() / count, 1e-9)
+        return {
+            "priority_skew": live.max() / mean_live,
+            "is_weight_min": weights.min(),
+            "is_weight_max": weights.max(),
+        }
+
     def _impl(
         self,
         num_moves: int,
@@ -301,7 +338,9 @@ class MegastepRunner:
         new_carry, outs = self.engine._chunk(
             num_moves, variables, carry, state.step.astype(jnp.int32)
         )
+        emit_beacon("rollout_chunk", state.step)
         mat, flush = outs.pop("mat"), outs.pop("flush")
+        ds_search = outs.pop("device_stats", None)
 
         # 2. Scatter the harvest into the device ring (same math as
         # DeviceReplayBuffer._ingest_impl, positions kept for PER).
@@ -309,6 +348,7 @@ class MegastepRunner:
             storage, cursor, (mat, flush), self.cap, with_positions=True
         )
         new_size = jnp.minimum(size + count, self.cap)
+        emit_beacon("ring_scatter", state.step)
 
         # 3. Max-priority init for the fresh rows (host-ring parity),
         # trash slot pinned to 0 so sampling can never return it.
@@ -322,6 +362,11 @@ class MegastepRunner:
         # immediately eligible, as in the sync loop's fold-then-sample).
         state, idx, weights = self._sample_indices(
             priorities, new_size, state, k
+        )
+        ds_per = (
+            self._per_stat_pack(priorities, weights)
+            if self.device_stats
+            else None
         )
 
         # 5. K fused learner steps gathered from the ring (the exact
@@ -351,6 +396,10 @@ class MegastepRunner:
             "metrics": metrics_k,
             "td": td_k,
             "idx": idx,
+            # Stat-pack legs (None = empty pytree nodes when off):
+            # search leg from the chunk's scanned waves, PER leg from
+            # the sampling phase. They ride this one fetch.
+            "device_stats": {"search": ds_search, "per": ds_per},
         }
         return new_state, new_carry, new_storage, priorities, out
 
@@ -399,7 +448,9 @@ class MegastepRunner:
         new_carry, outs = self.engine._chunk(
             num_moves, variables, carry, state.step.astype(jnp.int32)
         )
+        emit_beacon("rollout_chunk", state.step)
         mat, flush = outs.pop("mat"), outs.pop("flush")
+        ds_search = outs.pop("device_stats", None)
 
         # Per-call scalars for the shard_map region, computed OUTSIDE
         # it: one sampling key split off the train state (each shard
@@ -487,6 +538,16 @@ class MegastepRunner:
             out_specs=(shd, shd, shd, stk, stk, stk),
         )(storage, priorities, cursors, sizes, mat, flush,
           max_priority, k_sample, beta)
+        emit_beacon("ring_scatter", state.step)
+        # PER stat leg over the dp-sharded priority array + stacked
+        # weights: plain jnp reductions — GSPMD inserts the cross-shard
+        # collectives from the shardings, same idiom as the learner's
+        # gradient psum below.
+        ds_per = (
+            self._per_stat_pack(priorities, weights)
+            if self.device_stats
+            else None
+        )
 
         # 5. K fused learner steps on the (K, B) stacked batch, dp-
         # sharded on axis 1 (the shard_map's out_specs): GSPMD inserts
@@ -536,6 +597,7 @@ class MegastepRunner:
             "metrics": metrics_k,
             "td": td_k,
             "idx": idx,
+            "device_stats": {"search": ds_search, "per": ds_per},
         }
         return new_state, new_carry, new_storage, priorities, out
 
@@ -653,6 +715,7 @@ class MegastepRunner:
             self._name_fn(t, k),
             avals=f"B{self.batch_size}xT{t}xK{k}",
         ):
+            note_dispatch(self._name_fn(t, k))
             (
                 trainer.state,
                 engine._carry,
@@ -709,6 +772,29 @@ class MegastepRunner:
 
         # --- engine-side stats (play_chunk's host tail) --------------
         engine.last_trace = host["trace"]
+        if self.device_stats:
+            ds = host.get("device_stats") or {}
+            metrics = host["metrics"]
+            learner = {}
+            for src, dst in (
+                ("grad_norm", "grad_norm_max"),
+                ("update_norm", "update_norm_max"),
+            ):
+                if src in metrics:
+                    learner[dst] = round(float(np.max(metrics[src])), 6)
+            per = {
+                key: round(float(val), 6)
+                for key, val in (ds.get("per") or {}).items()
+            }
+            self.last_device_stats = {
+                "search": fold_search_stats(ds.get("search")),
+                "rollout": rollout_chunk_stats(
+                    host["episode"]["ending"], host["trace"]["reward"]
+                ),
+                "per": per or None,
+                "learner": learner or None,
+            }
+            engine.last_device_stats = self.last_device_stats
         engine._fold_episode_stats(host["episode"])
         engine._total_simulations += (
             int(host["trace"]["sims"].sum()) * engine.batch_size

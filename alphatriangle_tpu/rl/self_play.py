@@ -54,6 +54,14 @@ from ..env.engine import EnvState, TriangleEnv
 from ..features.core import FeatureExtractor
 from ..mcts.gumbel import GumbelMCTS
 from ..mcts.helpers import policy_target_from_visits, select_action_from_visits
+from ..telemetry.device_stats import (
+    beacon_signature,
+    beacons_armed,
+    device_stats_signature,
+    fold_search_stats,
+    note_dispatch,
+    rollout_chunk_stats,
+)
 from ..telemetry.flight import flight_span
 from ..mcts.search import BatchedMCTS
 from ..nn.network import NeuralNetwork
@@ -253,12 +261,20 @@ class SelfPlayEngine:
             # The config digest keys everything that shapes the program
             # but is invisible in its input avals (sim counts, n-step,
             # reward params, net architecture).
-            chunk_extra = config_digest(
-                self.mcts_config,
-                self.config,
-                extractor.model_config,
-                env.cfg,
-            ) + f"|lanes{self.data_axes if mesh is not None else ()}"
+            chunk_extra = (
+                config_digest(
+                    self.mcts_config,
+                    self.config,
+                    extractor.model_config,
+                    env.cfg,
+                )
+                + f"|lanes{self.data_axes if mesh is not None else ()}"
+                # Device telemetry shapes the program: the stat-pack
+                # adds output leaves, beacons embed host callbacks
+                # (which also make the executable non-serializable).
+                + device_stats_signature()
+                + beacon_signature()
+            )
             self._chunk_fn = functools.lru_cache(maxsize=None)(
                 lambda num_moves: get_compile_cache().wrap(
                     f"self_play_chunk/t{num_moves}",
@@ -267,6 +283,7 @@ class SelfPlayEngine:
                         donate_argnums=(1,),
                     ),
                     extra=chunk_extra,
+                    serialize=not beacons_armed(),
                 )
             )
 
@@ -299,6 +316,12 @@ class SelfPlayEngine:
         self.flight = None
         # (T, B) per-move diagnostics of the most recent chunk.
         self.last_trace: dict[str, np.ndarray] | None = None
+        # Device telemetry (telemetry/device_stats.py): the searches'
+        # stat-pack flag, snapshotted at construction like the MCTS
+        # instances themselves. When on, `last_device_stats` holds the
+        # most recent chunk's folded search + rollout legs.
+        self.device_stats = self.mcts.device_stats
+        self.last_device_stats: dict | None = None
 
     # --- multi-chip lane sharding -----------------------------------------
 
@@ -607,6 +630,10 @@ class SelfPlayEngine:
                     else jnp.zeros_like(out.root_value)
                 ),
             },
+            # Search stat-pack (None when DEVICE_STATS is off — an
+            # empty pytree node, so the off-path program is unchanged).
+            # (T,·)-stacked by the scan; rides the chunk's one fetch.
+            "device_stats": out.stats,
         }
         return new_carry, outputs
 
@@ -647,6 +674,7 @@ class SelfPlayEngine:
             f"self_play_chunk/t{t}",
             avals=f"B{self.batch_size}xT{t}",
         ):
+            note_dispatch(f"self_play_chunk/t{t}")
             self._carry, outputs = self._chunk_fn(t)(
                 self._place_variables(
                     self._inference_variables(self.net.variables, version),
@@ -677,6 +705,16 @@ class SelfPlayEngine:
         self._total_reused_visits += int(host["trace"]["reused"].sum())
 
         self.last_trace = host["trace"]
+        if self.device_stats:
+            # Search leg folded from the fetched stat-pack; rollout leg
+            # is a pure host fold over arrays the fetch ALREADY carried
+            # (per-step-of-T terminations, reward extremes).
+            self.last_device_stats = {
+                "search": fold_search_stats(host.get("device_stats")),
+                "rollout": rollout_chunk_stats(
+                    host["episode"]["ending"], host["trace"]["reward"]
+                ),
+            }
         episode = host["episode"]
         self._fold_episode_stats(episode)
         sentinels = int(host["sentinel_live"].sum())

@@ -34,6 +34,7 @@ from ..compile_cache import config_digest, get_compile_cache
 from ..config.mesh_config import MeshConfig
 from ..config.train_config import TrainConfig
 from ..nn.network import NeuralNetwork
+from ..telemetry.device_stats import emit_beacon
 from ..telemetry.flight import flight_span
 from ..parallel.sharding import (
     batch_sharding,
@@ -225,9 +226,16 @@ class Trainer:
         # keys the program shapers invisible in input avals: optimizer/
         # schedule/loss config, net architecture, board geometry.
         cache = get_compile_cache()
-        self._cache_extra = config_digest(
-            train_config, nn.model_config, nn.env_config
-        ) + f"|att{int(getattr(nn.model, 'attention_fn', None) is not None)}"
+        from ..telemetry.device_stats import beacon_signature, beacons_armed
+
+        self._cache_extra = (
+            config_digest(train_config, nn.model_config, nn.env_config)
+            + f"|att{int(getattr(nn.model, 'attention_fn', None) is not None)}"
+            # Beacon-armed learner programs embed host callbacks in the
+            # scan body — distinct executables, never serialized (the
+            # wrap sites pass serialize=False under arming).
+            + beacon_signature()
+        )
         # cpu_aot=False on every learner program: XLA:CPU DESERIALIZED
         # executables of this program family run without error but
         # return the donated train state UNCHANGED — params silently
@@ -247,6 +255,7 @@ class Trainer:
             ),
             extra=self._cache_extra,
             cpu_aot=False,
+            serialize=not beacons_armed(),
         )
         # Fused multi-step: batches stacked on a new leading K axis, dp
         # sharding on axis 1; one compiled program per distinct K.
@@ -264,6 +273,7 @@ class Trainer:
             ),
             extra=self._cache_extra,
             cpu_aot=False,
+            serialize=not beacons_armed(),
         )
         self._stacked_shard = stacked_shard
         # Device-buffer path (rl/device_buffer.py): batches are gathered
@@ -277,6 +287,7 @@ class Trainer:
             jax.jit(self._train_steps_from_impl, donate_argnums=(0,)),
             extra=self._cache_extra,
             cpu_aot=False,
+            serialize=not beacons_armed(),
         )
         # dp-sharded ring variant (rl/sharded_device_buffer.py): built
         # lazily on first use, cached per shard geometry — the program
@@ -386,6 +397,11 @@ class Trainer:
             "value_loss": aux["value_loss"],
             "entropy": aux["entropy"],
             "grad_norm": optax.global_norm(grads),
+            # Post-transform step size: grad_norm tells you what the
+            # loss surface did, update_norm what the optimizer actually
+            # applied — the pair separates "gradient explosion" from
+            # "adaptive-moment blowup" per fused step.
+            "update_norm": optax.global_norm(updates),
         }
         return new_state, metrics, aux["td_errors"]
 
@@ -412,6 +428,7 @@ class Trainer:
         """
 
         def body(st, batch):
+            emit_beacon("learner_step", st.step)
             new_st, metrics, td = self._train_step_impl(st, batch)
             return new_st, (metrics, td)
 
@@ -473,11 +490,14 @@ class Trainer:
                 )
                 return self._train_steps_impl(state, stacked)
 
+            from ..telemetry.device_stats import beacons_armed
+
             self._from_sharded_fns[key] = get_compile_cache().wrap(
                 f"learner_fused_from_sharded_ring/s{stride}_{dp_axis}",
                 jax.jit(impl, donate_argnums=(0,)),
                 extra=self._cache_extra,
                 cpu_aot=False,
+                serialize=not beacons_armed(),
             )
         return self._from_sharded_fns[key]
 

@@ -40,6 +40,14 @@ from collections import deque
 import numpy as np
 
 from ..mcts.helpers import select_root_actions
+from ..telemetry.device_stats import (
+    beacon_signature,
+    beacons_armed,
+    device_stats_signature,
+    fold_search_stats,
+    merge_search_folds,
+    note_dispatch,
+)
 from ..telemetry.flight import flight_span
 from .session import SessionSlots
 
@@ -106,12 +114,22 @@ class PolicyService:
         # but is invisible in its avals (sim budget, net architecture,
         # board, and the search-class/exploit mode, which swap _search
         # bodies entirely).
-        extra = config_digest(
-            mcts.config, extractor.model_config, env.cfg
-        ) + (
-            f"|{type(mcts).__name__}"
-            f"|exploit{int(getattr(mcts, 'exploit', False))}"
+        extra = (
+            config_digest(mcts.config, extractor.model_config, env.cfg)
+            + (
+                f"|{type(mcts).__name__}"
+                f"|exploit{int(getattr(mcts, 'exploit', False))}"
+            )
+            + device_stats_signature()
+            + beacon_signature()
         )
+        # Serve-wave stat-packs (telemetry/device_stats.py): snapshot
+        # the process-global here — it must match what `mcts` captured
+        # at construction, since `out.stats` exists iff the search was
+        # built with stats on.
+        self._device_stats = bool(getattr(mcts, "device_stats", False))
+        self._win_device_stats: list[dict] = []
+        self._last_serve_ds: "dict | None" = None
         # Subtree reuse (MCTSConfig.tree_reuse): each lane carries its
         # promoted search tree across dispatches, device-resident. The
         # serve program then fuses search + in-program action argmax +
@@ -149,10 +167,14 @@ class PolicyService:
                 serve_program_name(slots),
                 jax.jit(_serve_search_reuse),
                 extra=extra,
+                serialize=not beacons_armed(),
             )
         else:
             self._search = get_compile_cache().wrap(
-                serve_program_name(slots), mcts.search, extra=extra
+                serve_program_name(slots),
+                mcts.search,
+                extra=extra,
+                serialize=not beacons_armed(),
             )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.RLock()
@@ -375,6 +397,7 @@ class PolicyService:
                         self.dispatch_count,
                         flight_path=getattr(self.flight, "path", None),
                     )
+                note_dispatch(serve_program_name(self.sessions.slots))
                 reused_d = None
                 if self._tree_reuse:
                     import jax.numpy as jnp
@@ -403,8 +426,18 @@ class PolicyService:
                 fetch = (rewards, dones, self.sessions.states.score)
                 if reused_d is not None:
                     fetch += (reused_d,)
+                # Serve-wave stat-pack rides the SAME fetch (appended
+                # last so the positional `host[3]` reuse access below
+                # is untouched) — no extra device_get.
+                ds_dev = out.stats if self._device_stats else None
+                if ds_dev is not None:
+                    fetch += (ds_dev,)
                 host = jax.device_get(fetch)  # graftlint: allow(host-sync-in-hot-path) the one deliberate response fetch per dispatch
                 rewards_np, dones_np, scores_np = host[:3]
+                if ds_dev is not None:
+                    ds_fold = fold_search_stats(host[-1])
+                    if ds_fold:
+                        self._win_device_stats.append(ds_fold)
             t1 = self._clock()
 
             if self.emitter is not None:
@@ -519,11 +552,16 @@ class PolicyService:
             "serve_weight_reloads": self.weight_reloads,
         }
         if drain:
+            # Merge this window's per-wave search folds into one serve
+            # leg for tick() (device-stats plane; None when the feature
+            # is off or no wave ran this window).
+            self._last_serve_ds = merge_search_folds(self._win_device_stats)
             self._win_wait_ms = []
             self._win_lat_ms = []
             self._win_batch_ms = []
             self._win_fill = []
             self._win_requests = 0
+            self._win_device_stats = []
             self._last_tick_t = now
         return stats
 
@@ -534,6 +572,17 @@ class PolicyService:
         if self.telemetry is None:
             return None
         stats = self.serve_stats(drain=True)
+        extra = {k: v for k, v in stats.items() if v is not None}
+        serve_ds = getattr(self, "_last_serve_ds", None)
+        if serve_ds:
+            # Gauge fields for metrics.prom (ledger._PROM_HELP) ride
+            # the util record; the full leg lands as a device_stats
+            # ledger record below.
+            if serve_ds.get("root_entropy") is not None:
+                extra["root_visit_entropy"] = serve_ds["root_entropy"]
+            if serve_ds.get("occupancy") is not None:
+                extra["tree_occupancy"] = serve_ds["occupancy"]
+            extra["beacons_armed"] = int(beacons_armed())
         record = self.telemetry.on_util_tick(
             step=self.dispatch_count,
             episodes=self.episodes_done_total,
@@ -541,8 +590,15 @@ class PolicyService:
             simulations=self.simulations_total,
             reused_visits=self.reused_visits_total,
             buffer_size=self.queue_depth,
-            extra={k: v for k, v in stats.items() if v is not None},
+            extra=extra,
         )
+        if serve_ds and hasattr(self.telemetry, "record_device_stats"):
+            self.telemetry.record_device_stats(
+                self.dispatch_count,
+                serve=serve_ds,
+                program=serve_program_name(self.sessions.slots),
+            )
+            self._last_serve_ds = None
         self.telemetry.on_tick(
             self.dispatch_count, buffer_size=self.queue_depth
         )

@@ -335,6 +335,25 @@ def memory_line(util: dict) -> "str | None":
     return line
 
 
+def device_stats_line(util: dict) -> "str | None":
+    """Render the newest util record's device-stats gauges (the
+    in-program stat-pack mirror — telemetry/device_stats.py) as one
+    watch line; None when the run predates the plane or has it off."""
+    entropy = util.get("root_visit_entropy")
+    occupancy = util.get("tree_occupancy")
+    if not isinstance(entropy, (int, float)) and not isinstance(
+        occupancy, (int, float)
+    ):
+        return None
+    line = (
+        f"  search       root entropy {_fmt(entropy, ',.2f')}"
+        f"   tree occupancy {_fmt(occupancy * 100 if isinstance(occupancy, (int, float)) else None, ',.0f', '%')}"
+    )
+    if util.get("beacons_armed"):
+        line += "   BEACONS ARMED"
+    return line
+
+
 def last_dispatch_line(
     state: WatchState, now: "float | None" = None
 ) -> "str | None":
@@ -420,6 +439,9 @@ def render_frame(
         mline = memory_line(u)
         if mline is not None:
             lines.append(mline)
+        dsline = device_stats_line(u)
+        if dsline is not None:
+            lines.append(dsline)
     dline = last_dispatch_line(state)
     if dline is not None:
         lines.append(dline)

@@ -148,6 +148,19 @@ class RecoveryPolicy:
 
         reasons: list[str] = []
         wedged = verdict in WEDGE_VERDICTS or exit_code == WEDGE_EXIT_CODE
+        if wedged:
+            # A wedge respawn rebuilds every program anyway, so build
+            # them beacon-armed: if the SAME wedge recurs the next
+            # wedge_report / doctor verdict names its phase
+            # (telemetry/device_stats.py). `TELEMETRY__` keys are
+            # reserved telemetry directives — the runner pops them
+            # before TrainConfig construction.
+            if not self._overrides.get("TELEMETRY__BEACONS"):
+                self._overrides["TELEMETRY__BEACONS"] = True
+                reasons.append(
+                    "arming progress beacons for the respawn (a repeat "
+                    "wedge will name its phase)"
+                )
         if wedged and family:
             count = self._family_wedges.get(family, 0) + 1
             self._family_wedges[family] = count

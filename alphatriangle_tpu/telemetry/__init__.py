@@ -219,6 +219,8 @@ class RunTelemetry:
                     on_wedge=self._on_wedge,
                     exit_on_wedge=self.config.DISPATCH_EXIT_ON_WEDGE,
                     clock=clock,
+                    warn_fraction=self.config.DISPATCH_WARN_FRACTION,
+                    on_warn=self._on_dispatch_warn,
                 )
             # A parent (supervisor attempt / fleet spawn) may have
             # handed this process a trace context via the traceparent
@@ -237,6 +239,16 @@ class RunTelemetry:
                     parent_ctx.fields() if parent_ctx is not None else None
                 ),
             )
+        # Device-telemetry plane (telemetry/device_stats.py): point the
+        # beacon writer at this run's beacons.jsonl. No file is created
+        # until an armed program's callback actually fires.
+        if enabled:
+            try:
+                from .device_stats import attach_beacon_run_dir
+
+                attach_beacon_run_dir(self.run_dir)
+            except Exception:
+                logger.debug("beacon run-dir attach failed", exc_info=True)
         self._step = 0
         self._memory_seen: set = set()
         self._last_write_mono = None
@@ -323,6 +335,32 @@ class RunTelemetry:
         force flush and the collector's own close-time flush)."""
         if self.ledger is not None and means:
             self.ledger.append(tick_record(step, means))
+
+    def record_device_stats(
+        self, step: int, program: "str | None" = None, **legs
+    ) -> "dict | None":
+        """Ledger one ``kind:"device_stats"`` record from the legs the
+        host just folded out of the one per-iteration fetch (search /
+        rollout / per / learner / serve — telemetry/device_stats.py),
+        and screen the search leg for device-side anomalies (value
+        explosion, root-entropy collapse, occupancy saturation).
+        Returns the record, or None when every leg was empty."""
+        if not self.enabled:
+            return None
+        from .device_stats import device_stats_record
+
+        record = device_stats_record(step, program=program, **legs)
+        if record is None:
+            return None
+        if self.ledger is not None:
+            self.ledger.append(record)
+        search_leg = record.get("search") or record.get("serve")
+        if self.config.ANOMALY_ENABLED and search_leg:
+            for a in self.anomaly.observe_search(search_leg, step):
+                logger.warning("Training anomaly: %s", a.describe())
+                if self.stats is not None:
+                    self.stats.log_scalar(f"Anomaly/{a.kind}", 1.0, step)
+        return record
 
     def record_memory(self, record: "dict | None") -> None:
         """Ledger one static memory-attribution record (train-state
@@ -434,6 +472,26 @@ class RunTelemetry:
             self.run_dir / STACKS_FILENAME,
             self.run_dir / TRACE_FILENAME,
         )
+
+    def _on_dispatch_warn(self, info: dict) -> None:
+        """Near-deadline hook (DispatchWatchdog.warn_fraction): a
+        dispatch is running long — arm progress beacons NOW, so every
+        program built from here on (a supervised respawn rebuilds them
+        all) phases itself into beacons.jsonl. If this dispatch
+        recovers, the arming cost is a cache re-key; if it wedges, the
+        respawn's programs carry the forensics the first one lacked."""
+        self.tracer.instant(
+            "dispatch_warn",
+            program=info.get("program"),
+            elapsed_s=info.get("elapsed_s"),
+        )
+        try:
+            from .device_stats import arm_beacons, beacons_armed
+
+            if not beacons_armed():
+                arm_beacons(self.config.BEACON_EVERY_N_WAVES)
+        except Exception:
+            logger.exception("beacon arming on dispatch warn failed")
 
     def _on_wedge(self, info: dict) -> None:
         """Dispatch-watchdog hook (runs BEFORE wedge_report.json lands

@@ -24,6 +24,15 @@ Checks per observation:
   relative floor — the leak signature, as opposed to the sawtooth of
   a healthy allocator. Latched per excursion; any decrease re-arms and
   restarts the run.
+- **search-health** (`observe_search`, fed per device-stats record from
+  the in-program stat-packs — telemetry/device_stats.py): the search
+  leg's `value_abs_max` goes through the ordinary nonfinite/spike
+  screen (a value explosion INSIDE the fused program, attributed to the
+  step that produced it); `root_entropy` at/below a floor fires a
+  latched collapse (every root playing one forced move = the search's
+  exploration is gone, KataGo's degenerate-search signature); and
+  `occupancy` pinned at ~1.0 fires a latched `saturation` (the tree
+  arrays are full — simulations past that point are wasted slots).
 """
 
 import math
@@ -39,7 +48,8 @@ EPS_REL = 1e-3
 class Anomaly:
     """One detected anomaly, with recent-window context for the log."""
 
-    kind: str  # "nonfinite" | "spike" | "collapse" | "memory_growth"
+    # "nonfinite" | "spike" | "collapse" | "memory_growth" | "saturation"
+    kind: str
     metric: str
     step: int
     value: float
@@ -60,6 +70,11 @@ class Anomaly:
             parts.append(
                 f"bytes_in_use {self.value:,.0f} grew monotonically from "
                 f"{self.mean:,.0f} (possible leak)"
+            )
+        elif self.kind == "saturation":
+            parts.append(
+                f"value {self.value:.4g} at/above saturation ceiling — "
+                "tree slots exhausted, extra simulations are wasted"
             )
         else:
             parts.append(f"value {self.value!r}")
@@ -93,6 +108,8 @@ class AnomalyDetector:
         entropy_metrics: tuple[str, ...] = ("Loss/Entropy",),
         memory_growth_ticks: int = 12,
         memory_growth_fraction: float = 0.05,
+        search_entropy_floor: float = 0.05,
+        occupancy_ceiling: float = 0.98,
     ) -> None:
         self.alpha = alpha
         self.z_threshold = z_threshold
@@ -102,6 +119,11 @@ class AnomalyDetector:
         self.entropy_metrics = set(entropy_metrics)
         self.memory_growth_ticks = memory_growth_ticks
         self.memory_growth_fraction = memory_growth_fraction
+        self.search_entropy_floor = search_entropy_floor
+        self.occupancy_ceiling = occupancy_ceiling
+        # observe_search latches (one anomaly per excursion).
+        self._search_collapsed = False
+        self._search_saturated = False
         self._lock = threading.Lock()
         self._state: dict[str, _MetricState] = {}
         # Leak-detector state (observe_memory): baseline at the start
@@ -204,6 +226,60 @@ class AnomalyDetector:
                 )
             self._mem_recent.append((step, value))
             return out
+
+    def observe_search(self, leg: dict, step: int) -> list[Anomaly]:
+        """Screen one device-stats search leg (the host fold of the
+        in-program stat-pack — see module doc's search-health entry).
+        Tolerates partial legs: absent keys are skipped."""
+        out: list[Anomaly] = []
+        if not isinstance(leg, dict):
+            return out
+        v = leg.get("value_abs_max")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            # Nonfinite + z-spike via the standard per-metric screen:
+            # a value explosion is exactly a spike on this series.
+            out.extend(self.observe("Search/value_abs_max", float(v), step))
+        ent = leg.get("root_entropy")
+        if (
+            isinstance(ent, (int, float))
+            and not isinstance(ent, bool)
+            and math.isfinite(float(ent))
+        ):
+            with self._lock:
+                if float(ent) <= self.search_entropy_floor:
+                    if not self._search_collapsed:
+                        self._search_collapsed = True
+                        out.append(
+                            Anomaly(
+                                "collapse",
+                                "Search/root_entropy",
+                                step,
+                                float(ent),
+                            )
+                        )
+                else:
+                    self._search_collapsed = False
+        occ = leg.get("occupancy")
+        if (
+            isinstance(occ, (int, float))
+            and not isinstance(occ, bool)
+            and math.isfinite(float(occ))
+        ):
+            with self._lock:
+                if float(occ) >= self.occupancy_ceiling:
+                    if not self._search_saturated:
+                        self._search_saturated = True
+                        out.append(
+                            Anomaly(
+                                "saturation",
+                                "Search/tree_occupancy",
+                                step,
+                                float(occ),
+                            )
+                        )
+                else:
+                    self._search_saturated = False
+        return out
 
     def observe_metrics(
         self, metrics: dict[str, float], step: int

@@ -351,6 +351,13 @@ class DispatchWatchdog:
     doctor-smoke) — `os._exit(WEDGE_EXIT_CODE)`. `os._exit` because the
     thread that would run normal shutdown is the one blocked inside the
     hung dispatch. The clock is injectable so tests freeze it.
+
+    A near-deadline WARNING precedes the wedge: when a dispatch has
+    been in flight past `warn_fraction` of its deadline, `on_warn`
+    fires once for that dispatch (telemetry uses it to arm progress
+    beacons — device_stats.arm_beacons — so if the dispatch does wedge
+    and the supervisor respawns, or if it recovers and a LATER one
+    wedges, the rebuilt programs carry phase beacons).
     """
 
     def __init__(
@@ -360,11 +367,16 @@ class DispatchWatchdog:
         on_wedge=None,
         exit_on_wedge: bool = True,
         clock=time.monotonic,
+        warn_fraction: "float | None" = None,
+        on_warn=None,
     ) -> None:
         self.run_dir = Path(run_dir)
         self.poll_s = poll_s
         self.on_wedge = on_wedge
         self.exit_on_wedge = exit_on_wedge
+        self.warn_fraction = warn_fraction
+        self.on_warn = on_warn
+        self.warn_count = 0
         self._clock = clock
         self._lock = threading.Lock()
         self._armed: dict[int, dict] = {}
@@ -386,21 +398,51 @@ class DispatchWatchdog:
         dispatch is overdue (having fired the full reaction), else
         None. Called by the poll thread, and directly by tests."""
         now = self._clock() if now is None else now
+        warnings: list[dict] = []
         with self._lock:
             if self._fired:
                 return None
             overdue = None
             for info in self._armed.values():
                 elapsed = now - info["armed_at"]
-                if elapsed > float(info.get("deadline_s") or 0.0) and (
+                deadline = float(info.get("deadline_s") or 0.0)
+                if (
+                    self.warn_fraction is not None
+                    and not info.get("warned")
+                    and deadline > 0.0
+                    and elapsed > self.warn_fraction * deadline
+                ):
+                    # Near-deadline: warn once per dispatch, before the
+                    # wedge reaction (arming beacons here is what gives
+                    # the SECOND hang a phase attribution).
+                    info["warned"] = True
+                    self.warn_count += 1
+                    warnings.append(dict(info, elapsed_s=round(elapsed, 3)))
+                if elapsed > deadline and (
                     overdue is None or elapsed > overdue[1]
                 ):
                     overdue = (info, elapsed)
-            if overdue is None:
-                return None
-            self._fired = True
-            self.wedge_count += 1
-            info, elapsed = overdue
+            if overdue is not None:
+                self._fired = True
+                self.wedge_count += 1
+        for winfo in warnings:
+            logger.warning(
+                "DispatchWatchdog: %s (%s) at %.0f%% of its %.0fs "
+                "deadline (%.0fs elapsed) — near-deadline warning.",
+                winfo.get("program"),
+                winfo.get("family"),
+                100.0 * winfo["elapsed_s"] / float(winfo["deadline_s"]),
+                float(winfo.get("deadline_s") or 0.0),
+                winfo["elapsed_s"],
+            )
+            if self.on_warn is not None:
+                try:
+                    self.on_warn(winfo)
+                except Exception:
+                    logger.exception("on_warn hook failed")
+        if overdue is None:
+            return None
+        info, elapsed = overdue
         return self._fire(dict(info), elapsed)
 
     def _fire(self, info: dict, elapsed: float) -> dict:
@@ -439,6 +481,15 @@ class DispatchWatchdog:
             "stacks_file": str(stacks_path),
             "exit_code": WEDGE_EXIT_CODE if self.exit_on_wedge else None,
         }
+        try:
+            # Phase forensics: the newest progress-beacon row (None
+            # unless beacons were armed) names where the hung program —
+            # or its predecessor iteration — last reported.
+            from .device_stats import last_beacon
+
+            report["last_beacon"] = last_beacon(self.run_dir)
+        except Exception:
+            report["last_beacon"] = None
         write_wedge_report(self.run_dir / WEDGE_REPORT_FILENAME, report)
         if self.exit_on_wedge:
             # Flush logging/stdio by hand: _exit skips atexit and
@@ -622,6 +673,7 @@ def classify_run(
     wedge: "dict | None" = None,
     now: "float | None" = None,
     preempt: "dict | None" = None,
+    beacon: "dict | None" = None,
 ) -> dict:
     """Pure postmortem classifier over a run's on-disk evidence.
 
@@ -644,7 +696,13 @@ def classify_run(
       checkpoint restore).
     - `clean`: all intents sealed, no stall evidence.
 
-    Returns {verdict, exit_code, program, family, detail, evidence}.
+    `beacon` is the run's newest progress-beacon row (``last_beacon``,
+    device_stats.py; the wedge report's embedded copy wins when both
+    exist) — a hung verdict then carries it, naming the phase the
+    wedged program last announced.
+
+    Returns {verdict, exit_code, program, family, detail, evidence};
+    hung verdicts add `last_beacon` when a beacon row exists.
     """
     records = flight_records or []
     seals_by_program: dict[str, int] = {}
@@ -697,19 +755,32 @@ def classify_run(
         )
     if hung is not None:
         program, family, detail = hung
+        # Phase forensics: prefer the beacon row the wedge report froze
+        # at fire time; fall back to the caller-read beacons file.
+        beacon_row = (wedge or {}).get("last_beacon") or beacon
+        if isinstance(beacon_row, dict):
+            from .device_stats import describe_beacon
+
+            described = describe_beacon(beacon_row)
+            if described:
+                detail = f"{detail}; last beacon: {described}"
         if pressure is not None and pressure >= OOM_UTILIZATION:
-            return result(
+            verdict_dict = result(
                 "oom",
                 program,
                 family,
                 f"{detail}; device memory at {pressure:.0%} of limit",
             )
-        verdict = (
-            "dispatch-hung"
-            if seals_by_program.get(program, 0) > 0
-            else "compile-hung"
-        )
-        return result(verdict, program, family, detail)
+        else:
+            verdict = (
+                "dispatch-hung"
+                if seals_by_program.get(program, 0) > 0
+                else "compile-hung"
+            )
+            verdict_dict = result(verdict, program, family, detail)
+        if isinstance(beacon_row, dict):
+            verdict_dict["last_beacon"] = beacon_row
+        return verdict_dict
     if preempt is not None:
         ckpt = preempt.get("checkpointed_step")
         return result(

@@ -233,6 +233,11 @@ _PROM_HELP = {
     "serve_queue_wait_ms_p95": "Queue wait p95 this window, ms",
     "serve_batch_fill": "Real sessions per dispatch / slot count",
     "serve_weight_reloads": "Hot weight reloads served so far",
+    # Device-telemetry plane gauges (telemetry/device_stats.py): the
+    # loop mirrors the latest stat-pack fold onto its util records.
+    "root_visit_entropy": "Mean MCTS root visit entropy, nats (stat-pack)",
+    "tree_occupancy": "Mean search tree slot occupancy fraction (stat-pack)",
+    "beacons_armed": "1 when progress beacons are armed in this process",
 }
 
 

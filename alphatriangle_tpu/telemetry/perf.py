@@ -413,8 +413,22 @@ def summarize_utilization(
             "serve_batch_fill": _mean(numeric("serve_batch_fill")),
             "serve_weight_reloads": last.get("serve_weight_reloads"),
         }
+    # Device-stats gauges mirrored onto util records by the loop's
+    # iteration tail / serve tick (telemetry/device_stats.py). Absent
+    # on legacy and stats-off runs — then the block contributes nothing
+    # and the summary is byte-identical to the pre-plane shape.
+    devstats: dict = {}
+    if numeric("root_visit_entropy") or numeric("tree_occupancy"):
+        occ = numeric("tree_occupancy")
+        devstats = {
+            "root_visit_entropy": _mean(numeric("root_visit_entropy")),
+            "tree_occupancy": _mean(occ),
+            "tree_occupancy_max": max(occ) if occ else None,
+            "beacons_armed": last.get("beacons_armed"),
+        }
     return {
         **serve,
+        **devstats,
         "schema": SUMMARY_SCHEMA,
         "ticks": len(records),
         "ticks_total": full_span,

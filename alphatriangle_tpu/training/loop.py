@@ -1268,10 +1268,44 @@ class TrainingLoop:
             total += int(self._megastep_runner.dispatch_count)
         return total
 
+    def _drain_device_stats(self) -> "dict | None":
+        """The freshest in-program stat-pack fold (device-stats plane,
+        telemetry/device_stats.py): the megastep runner's when fused,
+        else the self-play engine's. Consumed once — the producer slot
+        is cleared so an idle iteration doesn't re-ledger stale stats."""
+        sources = []
+        if self._megastep_runner is not None:
+            sources.append(self._megastep_runner)
+        sources.append(self.c.self_play)
+        for rec in self._streams.values():
+            engine = rec.get("engine")
+            if engine is not None and engine is not self.c.self_play:
+                sources.append(engine)
+        for src in sources:
+            ds = getattr(src, "last_device_stats", None)
+            if ds:
+                src.last_device_stats = None
+                return ds
+        return None
+
     def _iteration_tail(self) -> None:
         if self.cfg.PROFILE_WORKERS:
             for name, val in self.profile.timers.metrics().items():
                 self.c.stats.log_scalar(name, val, self.global_step)
+        # Device-stats record + the gauge mirror for metrics.prom /
+        # `cli watch` (None on legacy/off runs — zero new fields then).
+        ds = self._drain_device_stats()
+        extra = {}
+        if ds:
+            self.telemetry.record_device_stats(self.global_step, **ds)
+            search = ds.get("search") or {}
+            if search.get("root_entropy") is not None:
+                extra["root_visit_entropy"] = search["root_entropy"]
+            if search.get("occupancy") is not None:
+                extra["tree_occupancy"] = search["occupancy"]
+            from ..telemetry.device_stats import beacons_armed
+
+            extra["beacons_armed"] = int(beacons_armed())
         # Utilization record first (ledger + heartbeat fields), then the
         # heartbeat write (health.json) — before the stats tick so any
         # Anomaly/* or Health/* events logged this iteration flush too.
@@ -1288,6 +1322,7 @@ class TrainingLoop:
             transfer_d2h_s=d2h,
             dispatches=self._total_dispatches(),
             iterations=self.iterations,
+            extra=extra or None,
         )
         self.telemetry.on_tick(self.global_step, len(self.c.buffer))
         self.c.stats.process_and_log(self.global_step)

@@ -65,6 +65,26 @@ def _apply_supervise_overrides(train_config: TrainConfig) -> TrainConfig:
         return train_config
     if not isinstance(overrides, dict) or not overrides:
         return train_config
+    # Reserved telemetry directives ride the same override channel but
+    # are NOT TrainConfig fields — pop them before construction. The
+    # only one today: `TELEMETRY__BEACONS` (the policy sets it on a
+    # wedge respawn) arms progress beacons process-wide BEFORE any
+    # engine compiles, so the rebuilt programs phase themselves into
+    # beacons.jsonl (telemetry/device_stats.py).
+    telemetry_keys = {
+        k: overrides.pop(k)
+        for k in [k for k in overrides if k.startswith("TELEMETRY__")]
+    }
+    if telemetry_keys.get("TELEMETRY__BEACONS"):
+        from ..telemetry.device_stats import arm_beacons
+
+        arm_beacons()
+        logger.warning(
+            "Supervisor directive TELEMETRY__BEACONS: progress beacons "
+            "armed for this respawn."
+        )
+    if not overrides:
+        return train_config
     resolved: dict = {}
     for key, value in overrides.items():
         if key.endswith("__scale"):

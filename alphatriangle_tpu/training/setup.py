@@ -229,6 +229,19 @@ def setup_training_components(
             update={"RUN_NAME": train_config.RUN_NAME}
         )
 
+    # Resolve the telemetry config FIRST and publish the device-stats
+    # flag process-wide: engines snapshot it at CONSTRUCTION (it shapes
+    # their compiled programs and joins the AOT cache digests), so the
+    # flag must be settled before SelfPlayEngine/Trainer exist. Set on
+    # every process unconditionally — a primary-only gate would compile
+    # DIFFERENT programs per process and deadlock a multi-host mesh.
+    telemetry_config = telemetry_config or TelemetryConfig()
+    from ..telemetry.device_stats import set_device_stats
+
+    set_device_stats(
+        telemetry_config.ENABLED and telemetry_config.DEVICE_STATS
+    )
+
     try:
         mesh = mesh_config.build_mesh()
     except ValueError as exc:

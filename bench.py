@@ -404,6 +404,19 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             ),
         },
     }
+    # Device-stats plane (telemetry/device_stats.py): when the engine
+    # compiled stat-packs in (ALPHATRIANGLE_DEVICE_STATS / config), the
+    # newest in-program search/rollout fold rides the BENCH snapshot.
+    ds_legs = getattr(engine, "last_device_stats", None)
+    if ds_legs:
+        from alphatriangle_tpu.telemetry.device_stats import (
+            device_stats_json,
+            device_stats_record,
+        )
+
+        ds_rec = device_stats_record(moves, **ds_legs)
+        if ds_rec is not None:
+            extra["device_stats"] = device_stats_json([ds_rec])
 
     def snapshot(partial: "str | None") -> dict:
         global _last_partial

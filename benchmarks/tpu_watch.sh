@@ -85,7 +85,8 @@ archive_window() {
   mkdir -p "$dest"
   for f in flight.jsonl flight.jsonl.1 health.json wedge_report.json \
            wedge_stacks.txt stall_stacks.txt trace.json \
-           supervisor.jsonl preempt_report.json fleet.jsonl; do
+           supervisor.jsonl preempt_report.json fleet.jsonl \
+           beacons.jsonl; do
     [ -f "$run_dir/$f" ] && cp "$run_dir/$f" "$dest/" 2>/dev/null
   done
   # Per-attempt report archives a supervised window's restarts left
@@ -98,8 +99,12 @@ archive_window() {
   verdict=$(timeout 60 python -m alphatriangle_tpu.cli doctor "$run_dir" --json 2>/dev/null)
   rc=$?
   [ -n "$verdict" ] || verdict='{"verdict": "unreadable", "exit_code": null}'
-  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "doctor": %s, "lint": %s}\n' \
-    "$ts" "$why" "$run_dir" "$verdict" "$lint_row" >> "$runs_root/_windows/windows.jsonl"
+  # Device-stats presence bit: did the window's ledger carry any
+  # in-program stat-pack records (telemetry/device_stats.py)?
+  device_stats=0
+  grep -q '"kind": *"device_stats"' "$run_dir/metrics.jsonl" 2>/dev/null && device_stats=1
+  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "device_stats": %s, "doctor": %s, "lint": %s}\n' \
+    "$ts" "$why" "$run_dir" "$device_stats" "$verdict" "$lint_row" >> "$runs_root/_windows/windows.jsonl"
   echo "$verdict" > "$dest/doctor.json"
   echo "$(date +%T) window archived: $dest ($why, doctor rc=$rc)" >&2
 }

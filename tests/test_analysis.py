@@ -182,6 +182,38 @@ class TestHostSyncInHotPath:
         assert hits[0].line == 5
         assert r.suppressed_pragma == 1
 
+    def test_io_callback_true_positive_beacon_callback_sanctioned(
+        self, tmp_path
+    ):
+        # The device-telemetry plane's beacons use jax.debug.callback
+        # (unordered, fire-and-forget) — sanctioned in hot programs.
+        # io_callback blocks the program on the host round-trip: flagged.
+        src = """
+            import jax
+            from jax.experimental import io_callback
+
+            def wave_body(k, carry):
+                jax.debug.callback(lambda i: None, k, ordered=False)
+                io_callback(lambda i: i, k, k)
+                return carry
+        """
+        r = lint_tree(tmp_path, {"mcts/beacons.py": src})
+        hits = [f for f in r.findings if f.rule == "host-sync-in-hot-path"]
+        assert len(hits) == 1
+        assert "io_callback" in hits[0].message
+        assert hits[0].line == 7
+
+    def test_debug_callback_alone_is_clean(self, tmp_path):
+        src = """
+            import jax
+
+            def wave_body(k, carry):
+                jax.debug.callback(lambda i: None, k, ordered=False)
+                return carry
+        """
+        r = lint_tree(tmp_path, {"rl/beacons.py": src})
+        assert "host-sync-in-hot-path" not in rules_hit(r)
+
     def test_training_loop_and_flywheel_are_hot(self, tmp_path):
         src = """
             def f(x):

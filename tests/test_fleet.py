@@ -439,7 +439,10 @@ def test_serve_wedge_quarantines_onto_smaller_bucket():
         progress_step=5,
     )
     assert a.kind == "restart"
-    assert a.overrides == {"SERVE_SLOTS__scale": 0.5}
+    assert a.overrides == {
+        "SERVE_SLOTS__scale": 0.5,
+        "TELEMETRY__BEACONS": True,
+    }
 
 
 class TestServeDispatchFaultSite:
@@ -586,7 +589,10 @@ class TestFleetSupervisor:
         assert death["family"] == "serve"
         assert death["program"] == "serve/b8"
         assert death["action"] == "restart"
-        assert death["overrides"] == {"SERVE_SLOTS__scale": 0.5}
+        assert death["overrides"] == {
+            "SERVE_SLOTS__scale": 0.5,
+            "TELEMETRY__BEACONS": True,
+        }
         assert death["progress_moves"] == 24
 
         # Before the backoff expires: no respawn yet.

@@ -496,3 +496,72 @@ class TestWedgeExitCodeContract:
         (1, 2, 126-165, 255) and doctor (0-6) codes."""
         assert WEDGE_EXIT_CODE == 113
         assert WEDGE_EXIT_CODE not in DOCTOR_EXIT_CODES.values()
+
+
+class TestLegacyBeaconTolerance:
+    """Run dirs from BEFORE the beacon channel existed (no
+    beacons.jsonl, wedge reports without `last_beacon`) must classify
+    and doctor exactly as they always did — no beacon line invented,
+    no `last_beacon` key in the verdict."""
+
+    def test_last_beacon_missing_file_is_none(self, tmp_path):
+        from alphatriangle_tpu.telemetry.device_stats import last_beacon
+
+        assert last_beacon(tmp_path) is None
+        assert last_beacon(tmp_path / "ghost" / "beacons.jsonl") is None
+
+    def test_classify_legacy_wedge_report_no_beacon_key(self):
+        wedge = {
+            "program": "megastep/t4_k2",
+            "family": "megastep",
+            "elapsed_s": 99.0,
+            "deadline_s": 5.0,
+        }
+        v = classify_run([_intent(1), _seal(1), _intent(2)], wedge=wedge)
+        assert v["verdict"] == "dispatch-hung"
+        assert "last_beacon" not in v
+        assert "last beacon" not in v["detail"]
+
+    def test_classify_caller_beacon_fallback(self):
+        """When the wedge report predates the beacon field, a caller-
+        read beacons.jsonl row still names the phase."""
+        wedge = {"program": "megastep/t4_k2", "family": "megastep",
+                 "elapsed_s": 99.0, "deadline_s": 5.0}
+        row = {"program": "megastep/t4_k2", "phase": "learner_step",
+               "index": 7, "monotonic": 12.5}
+        v = classify_run(
+            [_intent(1), _seal(1), _intent(2)], wedge=wedge, beacon=row
+        )
+        assert v["last_beacon"] == row
+        assert "last beacon" in v["detail"]
+        assert "learner_step" in v["detail"]
+
+    def test_cli_doctor_legacy_run_prints_no_beacon(self, tmp_path, capsys):
+        from alphatriangle_tpu.cli import main as cli_main
+
+        run = tmp_path / "legacy_run"
+        run.mkdir()
+        (run / FLIGHT_FILENAME).write_text(
+            _flight_line(**_intent(1))
+            + _flight_line(**_seal(1))
+            + _flight_line(**_intent(2))
+        )
+        rc = cli_main(["doctor", str(run)])
+        out = capsys.readouterr().out
+        assert rc == DOCTOR_EXIT_CODES["dispatch-hung"]
+        assert "beacon" not in out
+
+    def test_cli_doctor_legacy_json_has_no_beacon_key(self, tmp_path, capsys):
+        from alphatriangle_tpu.cli import main as cli_main
+
+        run = tmp_path / "legacy_run_json"
+        run.mkdir()
+        (run / FLIGHT_FILENAME).write_text(
+            _flight_line(**_intent(1))
+            + _flight_line(**_seal(1))
+            + _flight_line(**_intent(2))
+        )
+        rc = cli_main(["doctor", str(run), "--json"])
+        verdict = json.loads(capsys.readouterr().out)
+        assert rc == DOCTOR_EXIT_CODES["dispatch-hung"]
+        assert "last_beacon" not in verdict

@@ -493,3 +493,62 @@ class TestFlopsPeakOverride:
         assert peak_bf16_tflops_info("TPU v5 lite") == (394.0, "table")
         assert peak_bf16_tflops_info("TPU v5litepod-8") == (394.0, "table")
         assert peak_bf16_tflops_info("Quantum Q1") == (None, "unknown")
+
+
+class TestLegacyDeviceStatsTolerance:
+    """Runs recorded BEFORE the device-telemetry plane existed (no
+    `kind:"device_stats"` records, no stat-pack gauges on the util
+    ticks) must keep reading exactly as they always did: no ds_* keys
+    invented, no search-health line printed, compare still clean."""
+
+    def test_perf_json_has_no_ds_fields(self, tmp_path, capsys):
+        run = synthetic_run(tmp_path)
+        rc = cli_main(["perf", str(run), "--json"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert not [k for k in summary if k.startswith("ds_")]
+        assert "root_visit_entropy" not in summary
+        assert "tree_occupancy" not in summary
+
+    def test_perf_text_has_no_search_health_line(self, tmp_path, capsys):
+        run = synthetic_run(tmp_path)
+        rc = cli_main(["perf", str(run)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "entropy" not in out
+        assert "ingest/per" not in out
+
+    def test_summarize_device_stats_none_on_legacy(self, tmp_path):
+        from alphatriangle_tpu.telemetry.device_stats import (
+            summarize_device_stats,
+        )
+
+        run = synthetic_run(tmp_path)
+        recs = read_ledger(run / "metrics.jsonl", kinds={"device_stats"})
+        assert recs == []
+        assert summarize_device_stats(recs) is None
+
+    def test_compare_legacy_run_vs_ds_reference_clean(self, tmp_path, capsys):
+        """A reference regenerated WITH ds_* fields must not regress a
+        legacy run: ds_* keys are not in COMPARE_METRICS, so the rows
+        stay absent unless --metrics names them explicitly."""
+        run = synthetic_run(tmp_path)
+        rc = cli_main(["perf", str(run), "--json"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        ref = dict(
+            summary,
+            ds_records=12,
+            ds_root_entropy=1.2,
+            ds_tree_occupancy=0.4,
+            root_visit_entropy=1.2,
+        )
+        ref_path = tmp_path / "ref_ds.json"
+        ref_path.write_text(json.dumps(ref))
+        assert cli_main(["compare", str(run), str(ref_path)]) == 0
+
+    def test_watch_renders_no_devstats_line_on_legacy(self):
+        from alphatriangle_tpu.stats.watch import device_stats_line
+
+        assert device_stats_line({}) is None
+        assert device_stats_line({"mfu": 0.5, "steps_per_sec": 1.0}) is None

@@ -97,19 +97,28 @@ class TestRecoveryPolicy:
             family="megastep",
             progress_step=2,
         )
-        assert a1.overrides == {}
+        # The first wedge arms progress beacons so a repeat names its
+        # phase; quarantine waits for the second.
+        assert a1.overrides == {"TELEMETRY__BEACONS": True}
+        assert "beacons" in a1.reason
         a2 = policy.decide(
             verdict="dispatch-hung",
             exit_code=WEDGE_EXIT_CODE,
             family="megastep",
             progress_step=4,
         )
-        assert a2.overrides == {"FUSED_MEGASTEP": False}
+        assert a2.overrides == {
+            "FUSED_MEGASTEP": False,
+            "TELEMETRY__BEACONS": True,
+        }
         assert "quarantined" in a2.reason
         # A later unrelated death keeps the quarantine (overrides
         # accumulate; a sick megastep stays off).
         a3 = policy.decide(verdict="clean", exit_code=1, progress_step=6)
-        assert a3.overrides == {"FUSED_MEGASTEP": False}
+        assert a3.overrides == {
+            "FUSED_MEGASTEP": False,
+            "TELEMETRY__BEACONS": True,
+        }
 
     def test_wedge_by_exit_code_alone_counts(self):
         # Evidence can be thin (e.g. verdict unreadable): the watchdog's
@@ -119,7 +128,10 @@ class TestRecoveryPolicy:
             verdict="clean", exit_code=WEDGE_EXIT_CODE, family="rollout",
             progress_step=2,
         )
-        assert a.overrides == {"ASYNC_ROLLOUTS": False}
+        assert a.overrides == {
+            "ASYNC_ROLLOUTS": False,
+            "TELEMETRY__BEACONS": True,
+        }
 
     def test_oom_ladder_halves_then_forces_k1(self):
         policy = make_policy(circuit_breaker_deaths=99)
@@ -268,9 +280,13 @@ class TestSupervisor:
         assert sup.run() == 0
 
         assert len(calls) == 2
-        # The quarantine override reaches the second child via env.
+        # The quarantine override (and the wedge's beacon directive)
+        # reaches the second child via env.
         overrides = json.loads(calls[1]["env"][OVERRIDES_ENV])
-        assert overrides == {"FUSED_MEGASTEP": False}
+        assert overrides == {
+            "FUSED_MEGASTEP": False,
+            "TELEMETRY__BEACONS": True,
+        }
         assert OVERRIDES_ENV not in calls[0]["env"]
         assert sleeps == [7.0]
 
