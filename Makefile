@@ -9,7 +9,7 @@
 
 PY ?= python
 
-.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke reuse-smoke devstats-smoke
+.PHONY: check lint type test bench-smoke perf-smoke serve-smoke tune-smoke doctor-smoke ops-smoke league-smoke chaos-smoke fleet-smoke trace-smoke reuse-smoke devstats-smoke roofline-smoke
 
 check: lint type test
 
@@ -156,6 +156,19 @@ reuse-smoke:
 # last_beacon the jax-blocked `cli doctor` verdict names.
 devstats-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/devstats_smoke.py
+
+# Roofline-attribution gate (docs/OBSERVABILITY.md "Roofline & gap
+# attribution"): a short CPU training run must leave `.cost.json`
+# sidecars + ledger `kind:"cost"` records for the chunk/learner/
+# megastep/serve program families, `cli roofline` (jax-free) must
+# classify every hot family and attribute >= 95% of the run's wall
+# across dispatch + named gap categories, the chip-idle gauge must
+# ride util records into `cli perf --json`/`cli compare`, and the
+# perf reference must still hold with dispatches_per_iteration
+# unchanged. Regenerate the reference after intentional changes:
+#   $(PY) benchmarks/perf_smoke.py --write-reference
+roofline-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/roofline_smoke.py
 
 # Fit-driven autotuner gate (docs/AUTOTUNE.md): `cli tune cpu --smoke`
 # under a host-RAM byte limit must emit a tuned_preset.json that

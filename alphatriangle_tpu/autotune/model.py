@@ -68,8 +68,12 @@ class Calibration:
     is measured p50 dispatch wall per program family (rollout /
     learner / megastep / serve) from the run's flight ring
     (telemetry/flight.py) — ground truth the analytic FLOP model can
-    be sanity-checked against. `sources` records where each term came
-    from for the artifact's provenance block.
+    be sanity-checked against. `cost_flops` is compiler-reported FLOPs
+    per dispatch per family (XLA `cost_analysis()` records captured by
+    the roofline plane, telemetry/roofline.py) — when present it
+    anchors `efficiency` to compiler ground truth instead of the
+    analytic estimate. `sources` records where each term came from for
+    the artifact's provenance block.
     """
 
     efficiency: float = DEFAULT_EFFICIENCY
@@ -77,6 +81,7 @@ class Calibration:
     overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S
     outcome_scale: float = 1.0
     family_seconds: dict = field(default_factory=dict)
+    cost_flops: dict = field(default_factory=dict)
     sources: list = field(default_factory=lambda: ["defaults"])
 
     def as_dict(self) -> dict:
@@ -86,6 +91,7 @@ class Calibration:
             "overhead_s_per_dispatch": self.overhead_s,
             "outcome_scale": self.outcome_scale,
             "family_seconds": dict(self.family_seconds),
+            "cost_flops": dict(self.cost_flops),
             "sources": list(self.sources),
         }
 
@@ -163,11 +169,15 @@ def merge_calibrations(calibrations: list) -> Calibration:
     scales = [c.outcome_scale for c in cals]
     sources: list = []
     fam_samples: dict = {}
+    cost_samples: dict = {}
     for c in cals:
         sources.extend(c.sources)
         for fam, secs in (c.family_seconds or {}).items():
             if isinstance(secs, (int, float)):
                 fam_samples.setdefault(fam, []).append(float(secs))
+        for fam, flops in (c.cost_flops or {}).items():
+            if isinstance(flops, (int, float)):
+                cost_samples.setdefault(fam, []).append(float(flops))
     return Calibration(
         efficiency=sum(effs) / len(effs),
         moves_per_game=(sum(mpgs) / len(mpgs)) if mpgs else None,
@@ -176,8 +186,38 @@ def merge_calibrations(calibrations: list) -> Calibration:
         family_seconds={
             fam: sum(v) / len(v) for fam, v in fam_samples.items()
         },
+        cost_flops={
+            fam: sum(v) / len(v) for fam, v in cost_samples.items()
+        },
         sources=sources,
     )
+
+
+def cost_anchored_efficiency(
+    cost_flops: dict, family_seconds: dict, peak_tflops
+) -> "float | None":
+    """Achieved MFU implied by compiler ground truth: max over families
+    of (cost_analysis FLOPs per dispatch / measured p50 dispatch wall)
+    / peak FLOP/s. The max (not mean) because the model's efficiency
+    term bounds what a well-shaped candidate can reach, and the busiest
+    family is the one the search is shaping. None unless some family
+    carries both terms and the implied fraction is sane (0 < eff <= 1
+    — a torn sidecar or clock skew must not poison the search)."""
+    if not isinstance(peak_tflops, (int, float)) or peak_tflops <= 0:
+        return None
+    best = None
+    for fam, flops in (cost_flops or {}).items():
+        secs = (family_seconds or {}).get(fam)
+        if (
+            isinstance(flops, (int, float))
+            and flops > 0
+            and isinstance(secs, (int, float))
+            and secs > 0
+        ):
+            eff = (flops / secs) / (peak_tflops * 1e12)
+            if 0 < eff <= 1 and (best is None or eff > best):
+                best = eff
+    return best
 
 
 def calibration_from_targets(
@@ -233,6 +273,28 @@ def calibration_from_targets(
                 if fams:
                     cal.family_seconds = fams
                     cal.sources.append(f"flight x{len(fams)}")
+                # Compiler-reported FLOPs per dispatch per family
+                # (`kind:"cost"` ledger records — the roofline plane,
+                # telemetry/roofline.py). Joined against the measured
+                # walls above, they anchor `efficiency` to compiler
+                # ground truth; absent sidecars (legacy run, capture
+                # off) leave the analytic/MFU estimate in place.
+                from ..telemetry.roofline import cost_flops_by_family
+
+                cost = cost_flops_by_family(
+                    read_ledger(ledger, kinds={"cost"})
+                )
+                if cost:
+                    cal.cost_flops = cost
+                    cal.sources.append(f"cost_flops x{len(cost)}")
+                    anchored = cost_anchored_efficiency(
+                        cost,
+                        cal.family_seconds,
+                        summary.get("peak_bf16_tflops"),
+                    )
+                    if anchored is not None:
+                        cal.efficiency = anchored
+                        cal.sources.append("efficiency<-cost_flops")
         if ratios:
             cal.outcome_scale = sum(ratios) / len(ratios)
             cal.sources.append(f"tune_outcome x{len(ratios)}")

@@ -750,6 +750,48 @@ def cmd_perf(args: argparse.Namespace) -> int:
     )
     if devstats is not None:
         summary.update(devstats)
+    # Roofline fold (telemetry/roofline.py): compiler cost records
+    # (`kind:"cost"`) joined against flight-seal walls → roofline_*
+    # fields, per-program intensity/bound columns, and the idle line.
+    # Gated on cost records existing, so pre-roofline ledgers render
+    # with ZERO new fields even though they carry a flight ring.
+    roof = None
+    cost_records = read_ledger(ledger, kinds={"cost"})
+    if cost_records:
+        from .telemetry.roofline import summarize_roofline
+
+        roof = summarize_roofline(
+            cost_records,
+            read_flight(ledger.parent / FLIGHT_FILENAME),
+            device_kind=summary.get("device_kind") or "",
+            peak_tflops=summary.get("peak_bf16_tflops"),
+            trace_path=ledger.parent / "trace.json",
+        )
+    if roof is not None:
+        if roof.get("machine_balance_flops_per_byte") is not None:
+            summary["roofline_machine_balance_flops_per_byte"] = roof[
+                "machine_balance_flops_per_byte"
+            ]
+            summary["roofline_peak_hbm_gbps"] = roof.get("peak_hbm_gbps")
+        attrib = roof.get("attribution")
+        if attrib:
+            summary["roofline_chip_idle_fraction"] = attrib.get(
+                "chip_idle_fraction"
+            )
+            summary["roofline_attributed_fraction"] = attrib.get(
+                "attributed_fraction"
+            )
+            summary["roofline_dispatch_s"] = attrib.get("dispatch_s")
+            summary["roofline_gap_s"] = attrib.get("gap_s")
+            for cat, s in (attrib.get("gaps") or {}).items():
+                summary[f"roofline_gap_{cat}_s"] = s
+        rows = {r["program"]: r for r in roof.get("programs") or []}
+        for p in programs or []:
+            r = rows.get(p.get("program"))
+            if r is not None:
+                p["intensity"] = r.get("intensity")
+                p["bound"] = r.get("bound")
+                p["roofline_fraction"] = r.get("roofline_fraction")
     if args.json:
         summary["source"] = str(ledger)
         print(_json.dumps(summary))
@@ -862,13 +904,33 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   sheds {_fmt_cell(summary.get('fleet_sheds'), ',.0f')}"
             f"   lost {_fmt_cell(summary.get('fleet_lost'), ',.0f')}"
         )
+    if roof is not None and roof.get("attribution"):
+        attrib = roof["attribution"]
+        gaps = attrib.get("gaps") or {}
+        gap_text = "  ".join(
+            f"{cat} {_fmt_cell(s, ',.1f', 1, 's')}"
+            for cat, s in gaps.items()
+            if isinstance(s, (int, float)) and s > 0
+        )
+        print(
+            f"  roofline     idle {_fmt_cell(attrib.get('chip_idle_fraction'), ',.1f', 100.0, '%')}"
+            f"   dispatch {_fmt_cell(attrib.get('dispatch_s'), ',.1f', 1, 's')}"
+            f"   attributed {_fmt_cell(attrib.get('attributed_fraction'), ',.1f', 100.0, '%')}"
+            + (f"   gaps: {gap_text}" if gap_text else "")
+        )
     if programs:
         # Measured per-program device time (flight recorder seals) —
         # busiest first; errors are ok:false seals (failed dispatches).
+        # Roofline columns (intensity FLOP/byte, bound, fraction of the
+        # roofline ceiling) appear only when cost records exist; rows
+        # without a cost sidecar degrade to "—" cells, never raise.
         width = max(max(len(p["program"]) for p in programs), 7)
-        print(f"  {'program':<{width}}  {'count':>6}  {'p50':>9}  {'p95':>9}  {'total':>9}  err")
+        head = f"  {'program':<{width}}  {'count':>6}  {'p50':>9}  {'p95':>9}  {'total':>9}  err"
+        if roof is not None:
+            head += f"  {'intensity':>10}  {'bound':>7}  {'roofline':>8}"
+        print(head)
         for p in programs:
-            print(
+            line = (
                 f"  {p['program']:<{width}}"
                 f"  {p['count']:>6}"
                 f"  {_fmt_cell(p['wall_s_p50'], ',.1f', 1e3, 'ms'):>9}"
@@ -876,6 +938,13 @@ def cmd_perf(args: argparse.Namespace) -> int:
                 f"  {_fmt_cell(p['wall_s_total'], ',.1f', 1, 's'):>9}"
                 f"  {p['errors']}"
             )
+            if roof is not None:
+                line += (
+                    f"  {_fmt_cell(p.get('intensity'), ',.1f'):>10}"
+                    f"  {p.get('bound') or '—':>7}"
+                    f"  {_fmt_cell(p.get('roofline_fraction'), ',.2f', 100.0, '%'):>8}"
+                )
+            print(line)
     print(
         f"  trend        {_fmt_cell(trend, '+,.1f', 100.0, '%')} "
         "(2nd-half vs 1st-half throughput)"
@@ -2172,6 +2241,117 @@ def cmd_mem(args: argparse.Namespace) -> int:
             )
             + f" (step {observed.get('step')})"
         )
+    return 0
+
+
+def cmd_roofline(args: argparse.Namespace) -> int:
+    """Roofline attribution report for a run: per-program arithmetic
+    intensity vs the device machine balance (compute- vs memory-bound,
+    achieved-vs-roofline fraction) plus chip-idle gap forensics over
+    the flight timeline (docs/OBSERVABILITY.md "Roofline & gap
+    attribution"). Rendered from run artifacts alone (`metrics.jsonl`
+    `kind:"cost"` records, `flight.jsonl`, `trace.json`) — never
+    imports JAX, safe beside a wedged chip. Missing/corrupt/legacy
+    cost sidecars degrade to "—" cells, never raise. Exit 0 on a
+    usable report, 2 when the run has neither cost records nor a
+    flight timeline (predates the roofline plane, or telemetry was
+    disabled)."""
+    import json as _json
+
+    from .telemetry.flight import FLIGHT_FILENAME, read_flight
+    from .telemetry.ledger import read_ledger, resolve_ledger_path
+    from .telemetry.perf import summarize_utilization
+    from .telemetry.roofline import summarize_roofline
+
+    target = Path(args.run) if args.run else None
+    if target is not None and target.exists():
+        ledger = resolve_ledger_path(target)
+    else:
+        run_dir = _resolve_run_dir(args.run, args.root_dir)
+        if run_dir is None:
+            return 2
+        ledger = resolve_ledger_path(run_dir)
+    if ledger is None:
+        print(f"no metrics ledger for {args.run}", file=sys.stderr)
+        return 2
+    run_dir = ledger.parent
+    records = read_ledger(ledger)
+    # Device identity + peak FLOP/s from the same summary `cli perf`
+    # renders (the writer stamped them onto util records).
+    util = summarize_utilization(records) or {}
+    summary = summarize_roofline(
+        [r for r in records if r.get("kind") == "cost"],
+        read_flight(run_dir / FLIGHT_FILENAME),
+        device_kind=util.get("device_kind") or "",
+        peak_tflops=util.get("peak_bf16_tflops"),
+        trace_path=run_dir / "trace.json",
+    )
+    if summary is None:
+        print(
+            f"{run_dir}: no cost records or flight timeline (run "
+            "predates the roofline plane, or telemetry was disabled)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        summary["source"] = str(ledger)
+        print(_json.dumps(summary))
+        return 0
+    peak = summary.get("peak_bf16_tflops")
+    hbm = summary.get("peak_hbm_gbps")
+    print(f"roofline {run_dir}")
+    print(
+        f"  device       {summary.get('device_kind') or '?'}"
+        f"   peak bf16 {_fmt_cell(peak, ',.0f', 1, ' TFLOP/s') if peak else 'unknown'}"
+        f"   hbm {_fmt_cell(hbm, ',.0f', 1, ' GB/s') if hbm else 'unknown'}"
+        + (
+            f" [{summary.get('peak_hbm_source')}]"
+            if summary.get("peak_hbm_source") not in (None, "unknown")
+            else ""
+        )
+        + (
+            f"   balance {_fmt_cell(summary.get('machine_balance_flops_per_byte'), ',.0f', 1, ' FLOP/B')}"
+            if summary.get("machine_balance_flops_per_byte") is not None
+            else ""
+        )
+    )
+    attrib = summary.get("attribution")
+    if attrib:
+        print(
+            f"  attribution  wall {_fmt_cell(attrib.get('wall_s'), ',.1f', 1, 's')}"
+            f"   dispatch {_fmt_cell(attrib.get('dispatch_s'), ',.1f', 1, 's')}"
+            f"   idle {_fmt_cell(attrib.get('chip_idle_fraction'), ',.1f', 100.0, '%')}"
+            f"   attributed {_fmt_cell(attrib.get('attributed_fraction'), ',.1f', 100.0, '%')}"
+            f"   dispatches {_fmt_cell(attrib.get('dispatches'), ',.0f')}"
+        )
+        gaps = attrib.get("gaps") or {}
+        gap_text = "   ".join(
+            f"{cat} {_fmt_cell(s, ',.2f', 1, 's')}"
+            for cat, s in gaps.items()
+            if isinstance(s, (int, float))
+        )
+        if gap_text:
+            print(f"  gaps         {gap_text}")
+    else:
+        print("  attribution  — (no flight timeline)")
+    programs = summary.get("programs") or []
+    if programs:
+        width = max(max(len(p["program"]) for p in programs), 7)
+        print(
+            f"  {'program':<{width}}  {'count':>6}  {'p50':>9}  {'total':>9}"
+            f"  {'gflops':>9}  {'intensity':>10}  {'bound':>7}  {'roofline':>8}"
+        )
+        for p in programs:
+            print(
+                f"  {p['program']:<{width}}"
+                f"  {_fmt_cell(p.get('count'), ',.0f'):>6}"
+                f"  {_fmt_cell(p.get('wall_s_p50'), ',.1f', 1e3, 'ms'):>9}"
+                f"  {_fmt_cell(p.get('wall_s_total'), ',.1f', 1, 's'):>9}"
+                f"  {_fmt_cell(p.get('flops'), ',.2f', 1e-9):>9}"
+                f"  {_fmt_cell(p.get('intensity'), ',.1f'):>10}"
+                f"  {p.get('bound') or '—':>7}"
+                f"  {_fmt_cell(p.get('roofline_fraction'), ',.2f', 100.0, '%'):>8}"
+            )
     return 0
 
 
@@ -3519,6 +3699,26 @@ def main(argv: list[str] | None = None) -> int:
         "--json", action="store_true", help="Emit records + budget as JSON."
     )
 
+    roofline = sub.add_parser(
+        "roofline",
+        help="Roofline attribution for a run: per-program intensity "
+        "vs machine balance + chip-idle gap forensics, from its "
+        "artifacts alone — no JAX import.",
+    )
+    roofline.add_argument(
+        "run",
+        nargs="?",
+        default=None,
+        help="Run name, run dir, or metrics.jsonl path "
+        "(default: latest run).",
+    )
+    roofline.add_argument("--root-dir", default=None)
+    roofline.add_argument(
+        "--json",
+        action="store_true",
+        help="Emit the roofline summary as one JSON line.",
+    )
+
     tune = sub.add_parser(
         "tune",
         help="Fit-driven offline autotuner: search batch/capacity/"
@@ -3678,6 +3878,7 @@ def main(argv: list[str] | None = None) -> int:
         "fleet": cmd_fleet,
         "league": cmd_league,
         "mem": cmd_mem,
+        "roofline": cmd_roofline,
         "lint": cmd_lint,
     }
     return handlers[args.command](args)

@@ -171,6 +171,11 @@ class CompileCache:
         # from this registry (RunTelemetry keeps its own seen-set, so
         # several runs in one process each ledger every record once).
         self.memory_records: dict[str, dict] = {}
+        # "name:key" -> cost record (telemetry/roofline.py): the same
+        # flow for `cost_analysis()` — compiler-reported FLOPs / bytes
+        # accessed / transcendentals, persisted as `.cost.json`
+        # sidecars and drained into run ledgers for `cli roofline`.
+        self.cost_records: dict[str, dict] = {}
 
     # --- wiring -----------------------------------------------------------
 
@@ -313,6 +318,80 @@ class CompileCache:
         with self._lock:
             return list(self.memory_records.values())
 
+    # --- cost attribution (telemetry/roofline.py) -------------------------
+
+    def cost_record_for(self, name: str, key: str) -> "dict | None":
+        with self._lock:
+            return self.cost_records.get(f"{name}:{key}")
+
+    def _register_cost(self, name: str, key: str, record: dict) -> None:
+        with self._lock:
+            self.cost_records.setdefault(f"{name}:{key}", record)
+
+    def capture_cost(
+        self, name: str, key: str, compiled, persist: bool = True
+    ) -> "dict | None":
+        """Record `compiled.cost_analysis()` for one program and (by
+        default) persist it as a `.cost.json` sidecar beside the
+        executable artifact — the exact twin of `capture_memory`, so
+        `cli roofline` can attribute a run without recompiling
+        anything. Never raises."""
+        existing = self.cost_record_for(name, key)
+        if existing is not None:
+            return existing
+        try:
+            from .telemetry.roofline import program_cost_record
+
+            record = program_cost_record(
+                name,
+                compiled,
+                backend=jax.default_backend(),
+                key=key,
+            )
+        except Exception:
+            return None
+        if record is None:
+            return None
+        self._register_cost(name, key, record)
+        if persist:
+            try:
+                import json
+
+                sidecar = self._path(name, key).with_suffix(".cost.json")
+                sidecar.parent.mkdir(parents=True, exist_ok=True)
+                tmp = sidecar.with_suffix(f".tmp{os.getpid()}")
+                tmp.write_text(json.dumps(record))
+                tmp.replace(sidecar)
+            except OSError:
+                logger.debug(
+                    "compile_cache: %s cost sidecar write failed", name
+                )
+        return record
+
+    def _load_cost_sidecar(self, name: str, key: str) -> "dict | None":
+        """Reload a previously persisted cost record on an AOT hit.
+        Missing, corrupt or wrong-kind sidecars return None (the caller
+        re-analyzes the reloaded executable) — torn files degrade,
+        never raise."""
+        try:
+            import json
+
+            sidecar = self._path(name, key).with_suffix(".cost.json")
+            record = json.loads(sidecar.read_text())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(record, dict) or record.get("kind") != "cost":
+            return None
+        record["origin"] = "sidecar"
+        self._register_cost(name, key, record)
+        return record
+
+    def cost_summary(self) -> list[dict]:
+        """Every program cost record this process captured (the bench
+        JSON's `extra.roofline.programs` block)."""
+        with self._lock:
+            return list(self.cost_records.values())
+
     # --- load / compile / serialize ---------------------------------------
 
     def load_or_compile(
@@ -340,9 +419,11 @@ class CompileCache:
                 dt = time.time() - t0
                 self._note("hit", name, dt)
                 # Attribution rides the hit too: prefer the persisted
-                # sidecar, fall back to analyzing the reloaded program.
+                # sidecars, fall back to analyzing the reloaded program.
                 if self._load_memory_sidecar(name, key) is None:
                     self.capture_memory(name, key, compiled)
+                if self._load_cost_sidecar(name, key) is None:
+                    self.capture_cost(name, key, compiled)
                 logger.info(
                     "compile_cache: %s HIT (%s, deserialized in %.2fs)",
                     name,
@@ -379,6 +460,7 @@ class CompileCache:
         self._note("miss", name, dt)
         logger.info("compile_cache: %s MISS (compiled in %.2fs)", name, dt)
         self.capture_memory(name, key, compiled)
+        self.capture_cost(name, key, compiled)
         if serialize:
             self._serialize(name, path, compiled)
         return compiled
@@ -541,14 +623,29 @@ class CachedProgram:
         survives into the cache dir even where the executable itself
         is CPU-bypassed); the default keeps analysis artifact-free.
         None when the program can't lower or the backend reports no
-        analysis. This is `cli fit`'s estimator entry point."""
+        analysis. This is `cli fit`'s estimator entry point.
+
+        The cost leg (telemetry/roofline.py) rides every branch: each
+        compiled object analyzed here also captures its
+        `cost_analysis()` record, so `cli roofline` covers programs
+        whose executables never touch the AOT artifact path
+        (cpu_aot=False families included). Cost sidecars persist
+        unconditionally — a `.cost.json` is a few hundred bytes of
+        compiler ground truth (autotune's `--calibrate` cost_flops
+        source reads them across processes), unlike the executable
+        artifact whose serialization `persist` actually guards."""
         key = self._cache.signature(self.name, args, self._extra)
         record = self._cache.memory_record_for(self.name, key)
-        if record is not None:
+        if record is not None and (
+            self._cache.cost_record_for(self.name, key) is not None
+        ):
             return record
         if self.aot_active:
             _, exe = self._executable_for(args)
             if exe is not _FALLBACK:
+                # Cost rides every analysis leg (telemetry/roofline.py):
+                # the same compiled object answers both questions.
+                self._cache.capture_cost(self.name, key, exe)
                 record = self._cache.memory_record_for(self.name, key)
                 if record is not None:
                     return record
@@ -562,10 +659,12 @@ class CachedProgram:
                 self.name,
                 _exc_brief(exc),
             )
-            return None
-        return self._cache.capture_memory(
+            return record
+        self._cache.capture_cost(self.name, key, compiled)
+        mem = self._cache.capture_memory(
             self.name, key, compiled, persist=persist
         )
+        return mem if mem is not None else record
 
     def __call__(self, *args):
         if not self.aot_active:

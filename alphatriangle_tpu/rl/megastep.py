@@ -844,7 +844,9 @@ class MegastepRunner:
         (telemetry/memory.py; `cli fit`) — AOT analysis only, nothing
         executes. The record persists as a `.mem.json` sidecar in the
         compile cache even on CPU, where the executable itself is
-        never serialized (cpu_aot bypass)."""
+        never serialized (cpu_aot bypass); the megastep family's
+        `cost_analysis()` record + `.cost.json` sidecar ride the same
+        compile (telemetry/roofline.py)."""
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         k = int(k or max(1, self.config.FUSED_LEARNER_STEPS))
         return self._megastep_fn(t, k).analyze(

@@ -790,7 +790,9 @@ class SelfPlayEngine:
     def analyze_chunk(self, num_moves: int | None = None) -> "dict | None":
         """Memory record of the rollout chunk program at this engine's
         real dispatch avals (telemetry/memory.py) — AOT analysis only,
-        nothing executes and the carry is untouched (`cli fit`)."""
+        nothing executes and the carry is untouched (`cli fit`). The
+        rollout family's `cost_analysis()` record rides the same
+        compile (telemetry/roofline.py)."""
         t = int(num_moves or self.config.ROLLOUT_CHUNK_MOVES)
         version = self.net.weights_version
         return self._chunk_fn(t).analyze(

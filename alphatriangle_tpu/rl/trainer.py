@@ -829,7 +829,11 @@ class Trainer:
 
     def analyze_step(self, batch_size: int | None = None) -> "dict | None":
         """Memory record of the per-step learner program (AOT-lowered,
-        never executed — works on CPU despite the cpu_aot bypass)."""
+        never executed — works on CPU despite the cpu_aot bypass).
+        The learner family's `cost_analysis()` record + `.cost.json`
+        sidecar ride the same compile (telemetry/roofline.py), which
+        is what gives `cli roofline` FLOP coverage of a family whose
+        executable never enters the AOT artifact path on CPU."""
         b = batch_size or self.config.BATCH_SIZE
         device_batch = shard_batch(
             self.mesh, self._zero_batch(b), self.dp_axis

@@ -583,6 +583,8 @@ class PolicyService:
             if serve_ds.get("occupancy") is not None:
                 extra["tree_occupancy"] = serve_ds["occupancy"]
             extra["beacons_armed"] = int(beacons_armed())
+        flight = getattr(self.telemetry, "flight", None)
+        dispatch_wall = getattr(flight, "sealed_wall_seconds", None)
         record = self.telemetry.on_util_tick(
             step=self.dispatch_count,
             episodes=self.episodes_done_total,
@@ -590,6 +592,7 @@ class PolicyService:
             simulations=self.simulations_total,
             reused_visits=self.reused_visits_total,
             buffer_size=self.queue_depth,
+            dispatch_wall_s=dispatch_wall,
             extra=extra,
         )
         if serve_ds and hasattr(self.telemetry, "record_device_stats"):

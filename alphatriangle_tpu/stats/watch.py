@@ -354,6 +354,20 @@ def device_stats_line(util: dict) -> "str | None":
     return line
 
 
+def idle_line(util: dict) -> "str | None":
+    """Render the newest util record's chip-idle gauge (the roofline
+    attribution plane — telemetry/roofline.py: fraction of the last
+    tick window with no dispatch in flight) as one watch line; None
+    when the run predates the plane or the flight ring is off."""
+    idle = util.get("chip_idle_fraction")
+    if not isinstance(idle, (int, float)):
+        return None
+    line = f"  roofline     chip idle {_fmt(idle * 100, ',.1f', '%')}"
+    if idle >= 0.5:
+        line += "  — HOST-BOUND?"
+    return line
+
+
 def last_dispatch_line(
     state: WatchState, now: "float | None" = None
 ) -> "str | None":
@@ -442,6 +456,9 @@ def render_frame(
         dsline = device_stats_line(u)
         if dsline is not None:
             lines.append(dsline)
+        iline = idle_line(u)
+        if iline is not None:
+            lines.append(iline)
     dline = last_dispatch_line(state)
     if dline is not None:
         lines.append(dline)

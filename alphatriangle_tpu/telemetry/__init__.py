@@ -22,6 +22,10 @@ to:
   capture via compile_cache), train-state/replay-ring byte accounting,
   and the static pre-flight budget behind `cli fit`/`cli mem`
   (docs/OBSERVABILITY.md "Memory").
+- `roofline` — per-program `cost_analysis()` capture (FLOPs, bytes
+  accessed), the arithmetic-intensity roofline model behind
+  `cli roofline`, and chip-idle gap forensics over the flight ring
+  (docs/OBSERVABILITY.md "Roofline & gap attribution").
 
 Podracer-style stacks (arXiv:2104.06272) treat this visibility as a
 prerequisite for scaling an async producer/learner loop; the repo's own
@@ -75,6 +79,14 @@ from .memory import (
 )
 from .merge import MERGED_TRACE_FILENAME, merge_fleet_trace
 from .perf import UtilizationMeter, summarize_utilization
+from .roofline import (
+    attribute_gaps,
+    cost_flops_by_family,
+    peak_hbm_gbps_info,
+    program_cost_record,
+    roofline_rows,
+    summarize_roofline,
+)
 from .slo import (
     FLEET_PROM_FILENAME,
     SLO_EXIT_CODES,
@@ -106,8 +118,14 @@ __all__ = [
     "tracectx",
     "UtilizationMeter",
     "Watchdog",
+    "attribute_gaps",
     "attribution_rows",
     "classify_run",
+    "cost_flops_by_family",
+    "peak_hbm_gbps_info",
+    "program_cost_record",
+    "roofline_rows",
+    "summarize_roofline",
     "flight_span",
     "read_flight",
     "summarize_flight",
@@ -251,6 +269,7 @@ class RunTelemetry:
                 logger.debug("beacon run-dir attach failed", exc_info=True)
         self._step = 0
         self._memory_seen: set = set()
+        self._cost_seen: set = set()
         self._last_write_mono = None
         self._last_written_step: int | None = None
         self._clock = clock
@@ -380,11 +399,21 @@ class RunTelemetry:
         try:
             from ..compile_cache import get_compile_cache
 
-            for record in get_compile_cache().memory_summary():
+            cache = get_compile_cache()
+            for record in cache.memory_summary():
                 rid = (record.get("program"), record.get("key"))
                 if rid in self._memory_seen:
                     continue
                 self._memory_seen.add(rid)
+                self.ledger.append(record)
+            # Same drain for compiler cost records (`kind:"cost"`,
+            # telemetry/roofline.py): `cli roofline` joins these against
+            # flight-seal walls without re-touching the compile cache.
+            for record in cache.cost_summary():
+                rid = (record.get("program"), record.get("key"))
+                if rid in self._cost_seen:
+                    continue
+                self._cost_seen.add(rid)
                 self.ledger.append(record)
         except Exception:  # accounting must never hurt the loop
             pass

@@ -238,6 +238,8 @@ _PROM_HELP = {
     "root_visit_entropy": "Mean MCTS root visit entropy, nats (stat-pack)",
     "tree_occupancy": "Mean search tree slot occupancy fraction (stat-pack)",
     "beacons_armed": "1 when progress beacons are armed in this process",
+    # Roofline attribution plane (telemetry/roofline.py).
+    "chip_idle_fraction": "Fraction of the tick window with no dispatch in flight",
 }
 
 

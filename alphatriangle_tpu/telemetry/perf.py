@@ -74,6 +74,12 @@ COMPARE_METRICS = (
     # compares well. Only fleet runs carry them.
     "fleet_move_latency_ms_p95",
     "fleet_requests_per_sec",
+    # Roofline attribution plane (telemetry/roofline.py): fraction of
+    # each tick window the chip spent idle between dispatches. Lower is
+    # better — a run that got faster by starving the chip less shows
+    # up here even when throughput gains are marginal. Only runs
+    # recorded with the dispatch-wall counter carry it.
+    "chip_idle_fraction",
 )
 
 # Metrics where a LOWER candidate value is the good direction.
@@ -83,6 +89,7 @@ LOWER_IS_BETTER = frozenset(
         "memory_budget_bytes",
         "serve_move_latency_ms_p95",
         "fleet_move_latency_ms_p95",
+        "chip_idle_fraction",
     }
 )
 
@@ -149,6 +156,7 @@ class UtilizationMeter:
         device_memory: "list | None" = None,
         dispatches: int = 0,
         iterations: int = 0,
+        dispatch_wall_s: "float | None" = None,
         extra: "dict | None" = None,
     ) -> "dict | None":
         """One derived utilization record, or None (first/zero-width tick).
@@ -156,7 +164,15 @@ class UtilizationMeter:
         `extra`: caller-owned fields merged verbatim into the record —
         the policy service rides its per-window `serve_*` SLO fields
         (queue wait / move latency percentiles, occupancy) into the
-        ledger this way (serving/service.py)."""
+        ledger this way (serving/service.py).
+
+        `dispatch_wall_s`: cumulative sealed dispatch wall from the
+        run's flight recorder (`FlightRecorder.sealed_wall_seconds`).
+        When supplied on consecutive ticks, the record carries
+        `chip_idle_fraction` — the fraction of the tick window the
+        device spent between dispatches (telemetry/roofline.py's live
+        gauge). Callers that never pass it (legacy wiring, tests) emit
+        records byte-identical to the pre-roofline shape."""
         now = self._clock()
         # Memory accounting folds on EVERY tick (including the baseline
         # tick that yields no rate record) so the high-water mark never
@@ -173,13 +189,23 @@ class UtilizationMeter:
             "dispatches": dispatches,
             "iterations": iterations,
         }
+        if isinstance(dispatch_wall_s, (int, float)):
+            cur["dispatch_wall_s"] = float(dispatch_wall_s)
         prev, self._prev = self._prev, {"t": now, **cur}
         if prev is None:
             return None
         dt = now - prev["t"]
         if dt <= 0:
             return None
-        d = {k: cur[k] - prev[k] for k in cur}
+        # The dispatch-wall counter may appear mid-run (flight recorder
+        # attached late); a delta only exists once BOTH ticks carry it.
+        d = {
+            k: cur[k] - prev[k] for k in cur if k in prev
+        }
+        chip_idle = None
+        if "dispatch_wall_s" in d:
+            busy = max(0.0, d["dispatch_wall_s"])
+            chip_idle = max(0.0, min(1.0, 1.0 - busy / dt))
         steps_s = max(0.0, d["step"]) / dt
         moves_s = max(0.0, d["experiences"]) / dt
         sims_s = max(0.0, d["simulations"]) / dt
@@ -262,6 +288,12 @@ class UtilizationMeter:
             ),
             "mesh_devices": self.mesh_devices,
         }
+        if chip_idle is not None:
+            # Live roofline gauge (telemetry/roofline.py): the window's
+            # sealed-dispatch wall over the window. Emitted ONLY when
+            # the counter was supplied, so legacy records keep their
+            # exact pre-roofline field set.
+            record["chip_idle_fraction"] = round(chip_idle, 6)
         if extra:
             record.update(extra)
         return record
@@ -426,9 +458,20 @@ def summarize_utilization(
             "tree_occupancy_max": max(occ) if occ else None,
             "beacons_armed": last.get("beacons_armed"),
         }
+    # Roofline idle gauge (telemetry/roofline.py), mirrored the same
+    # way: absent on pre-roofline runs, so legacy summaries keep their
+    # exact pre-roofline key set.
+    roofline: dict = {}
+    idle = numeric("chip_idle_fraction")
+    if idle:
+        roofline = {
+            "chip_idle_fraction": _mean(idle),
+            "chip_idle_fraction_max": max(idle),
+        }
     return {
         **serve,
         **devstats,
+        **roofline,
         "schema": SUMMARY_SCHEMA,
         "ticks": len(records),
         "ticks_total": full_span,

@@ -1311,6 +1311,11 @@ class TrainingLoop:
         # Anomaly/* or Health/* events logged this iteration flush too.
         h2d, d2h = self._transfer_seconds()
         self.iterations += 1
+        # Cumulative sealed-dispatch wall feeds the chip-idle gauge
+        # (telemetry/roofline.py); None on legacy/flight-off runs, so
+        # those util records carry zero new fields.
+        flight = getattr(self.telemetry, "flight", None)
+        dispatch_wall = getattr(flight, "sealed_wall_seconds", None)
         self.telemetry.on_util_tick(
             self.global_step,
             episodes=self.episodes_played,
@@ -1322,6 +1327,7 @@ class TrainingLoop:
             transfer_d2h_s=d2h,
             dispatches=self._total_dispatches(),
             iterations=self.iterations,
+            dispatch_wall_s=dispatch_wall,
             extra=extra or None,
         )
         self.telemetry.on_tick(self.global_step, len(self.c.buffer))

@@ -440,6 +440,24 @@ def setup_training_components(
             )
     except Exception:
         logger.exception("static memory attribution failed (continuing)")
+    # Compiler cost ground truth for the learner-side family
+    # (telemetry/roofline.py): on CPU those programs bypass the AOT
+    # dispatch path (cpu_aot=False), so nothing would ever capture
+    # their `cost_analysis()` — analyze once at setup. On accelerators
+    # this doubles as a warm-up: the analyzed executable is the cached
+    # one the first dispatch reuses. Best-effort like the block above;
+    # ALPHATRIANGLE_COST_PRECAPTURE=0 skips it (the test suite — the
+    # compile is pure overhead in seconds-long throwaway runs).
+    from ..telemetry.roofline import cost_precapture_enabled
+
+    if telemetry.enabled and cost_precapture_enabled():
+        try:
+            if megastep_runner is not None:
+                megastep_runner.analyze_megastep()
+            else:
+                trainer.analyze_step()
+        except Exception:
+            logger.exception("cost pre-capture failed (continuing)")
     all_configs = {
         "env": env_config,
         "model": model_config,

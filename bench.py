@@ -430,6 +430,10 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "device": device_memory_stats(),
             "programs": compile_cache.memory_summary(),
         }
+        # Compiler cost attribution (telemetry/roofline.py): every
+        # program's FLOPs/bytes-accessed next to its memory record, so
+        # a BENCH snapshot carries the roofline inputs too.
+        extra["roofline"] = {"programs": compile_cache.cost_summary()}
         r = {
             "metric": "self_play_games_per_hour",
             "value": round(games_per_hour, 1),

@@ -1,0 +1,397 @@
+"""CI roofline-smoke gate: CPU run -> cost sidecars -> `cli roofline`.
+
+`make roofline-smoke` runs this. It proves, on any machine with no
+accelerator, that the roofline attribution plane end to end still works
+(docs/OBSERVABILITY.md "Roofline & gap attribution"):
+
+1. a tiny CPU training run + a fused-megastep run + a serve-program
+   analysis leave `.cost.json` sidecars (XLA `cost_analysis()` FLOPs /
+   bytes-accessed, compile_cache.py) covering the rollout, learner,
+   megastep and serve program families;
+2. `cli roofline <run>` (JAX-free) classifies every hot family in the
+   training run compute- vs memory-bound with a roofline fraction
+   (non-null via the ALPHATRIANGLE_PEAK_TFLOPS / _PEAK_HBM_GBPS
+   overrides this script sets) and attributes >= 95% of the run's wall
+   across dispatch + named gap categories;
+3. the chip-idle gauge rides util records into `cli perf --json`
+   (`chip_idle_fraction`, `roofline_*` fields) while the flight
+   recorder's measured bookkeeping overhead stays under 1% of wall and
+   `dispatches_per_iteration` still lands;
+4. `cli compare <run> benchmarks/perf_reference_cpu_smoke.json` holds
+   against the checked-in reference (regenerate it with
+   `python benchmarks/perf_smoke.py --write-reference` after an
+   intentional schema change — the roofline fields ride that file).
+
+Exit 0 when every stage passes; the first failing stage's code
+otherwise.
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REFERENCE = Path(__file__).resolve().parent / "perf_reference_cpu_smoke.json"
+RUN_NAME = "roofline_smoke"
+
+# Runnable as `python benchmarks/roofline_smoke.py` without installing
+# the package: the repo root is the import root, and perf_smoke's tiny
+# world is importable from the benchmarks dir.
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+if str(REPO / "benchmarks") not in sys.path:
+    sys.path.insert(0, str(REPO / "benchmarks"))
+
+# Must precede any jax import. The peak overrides are what make CPU
+# MFU / machine balance non-null; the cache-dir override makes the
+# sidecar gate hermetic (a fresh dir, so every `.cost.json` found was
+# written by THIS process).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
+os.environ.setdefault("ALPHATRIANGLE_PEAK_HBM_GBPS", "1.0")
+# The smoke's whole point is cost coverage for the AOT-bypassed
+# learner family — force the setup pre-capture on even when invoked
+# from a shell that inherited the test suite's opt-out.
+os.environ["ALPHATRIANGLE_COST_PRECAPTURE"] = "1"
+_CACHE_DIR = tempfile.mkdtemp(prefix="at_roofline_cache_")
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
+
+
+def _roofline_json(cli_main, run: str, root: str) -> "dict | None":
+    """One `cli roofline --json` invocation's parsed summary."""
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["roofline", run, "--root-dir", root, "--json"])
+    if rc != 0:
+        return None
+    try:
+        return json.loads(buf.getvalue())
+    except json.JSONDecodeError:
+        return None
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.9,
+        help="compare tolerance vs the checked-in reference "
+        "(generous by design: CI hosts vary in speed).",
+    )
+    parser.add_argument(
+        "--root-dir",
+        default=None,
+        help="Runs root for the smoke runs (default: a temp dir).",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    from perf_smoke import tiny_configs
+
+    from alphatriangle_tpu.cli import main as cli_main
+    from alphatriangle_tpu.config import PersistenceConfig, TrainConfig
+    from alphatriangle_tpu.training import run_training
+
+    root = args.root_dir or tempfile.mkdtemp(prefix="at_roofline_smoke_")
+    env_cfg, model_cfg, mcts_cfg, train_cfg = tiny_configs()
+    train_cfg = TrainConfig(
+        **{**train_cfg.model_dump(), "RUN_NAME": RUN_NAME}
+    )
+    pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=RUN_NAME)
+    print(f"roofline-smoke: training {RUN_NAME} under {root}...", flush=True)
+    rc = run_training(
+        train_config=train_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(
+            f"roofline-smoke: training run failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+
+    print("roofline-smoke: fused-megastep run...", flush=True)
+    mega_run = f"{RUN_NAME}_megastep"
+    mega_cfg = TrainConfig(
+        **{
+            **train_cfg.model_dump(),
+            "RUN_NAME": mega_run,
+            "FUSED_MEGASTEP": True,
+            "DEVICE_REPLAY": "on",
+            "FUSED_LEARNER_STEPS": 2,
+            "MAX_TRAINING_STEPS": 4,
+        }
+    )
+    mega_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=mega_run)
+    rc = run_training(
+        train_config=mega_cfg,
+        env_config=env_cfg,
+        model_config=model_cfg,
+        mcts_config=mcts_cfg,
+        persistence_config=mega_pc,
+        use_tensorboard=False,
+        log_level="WARNING",
+    )
+    if rc != 0:
+        print(
+            f"roofline-smoke: megastep run failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+
+    print("roofline-smoke: serve-program cost analysis...", flush=True)
+    # The serve family never dispatches in a training run; its cost
+    # record comes from the same AOT-analysis leg `cli serve`'s
+    # pre-flight uses (analyze -> capture_cost, persist=True).
+    from alphatriangle_tpu.env.engine import TriangleEnv
+    from alphatriangle_tpu.features.core import get_feature_extractor
+    from alphatriangle_tpu.nn.network import NeuralNetwork
+    from alphatriangle_tpu.rl.self_play import SelfPlayEngine
+    from alphatriangle_tpu.serving import PolicyService
+
+    env = TriangleEnv(env_cfg)
+    extractor = get_feature_extractor(env, model_cfg)
+    net = NeuralNetwork(model_cfg, env_cfg, seed=0)
+    engine = SelfPlayEngine(
+        env, extractor, net, mcts_cfg, train_cfg, seed=0
+    )
+    service = PolicyService(env, extractor, net, engine.mcts, slots=4)
+    if service.analyze(persist=True) is None:
+        print(
+            "roofline-smoke: serve program analysis returned no record",
+            file=sys.stderr,
+        )
+        return 2
+
+    print("roofline-smoke: cost sidecar gate...", flush=True)
+    import json as _json
+
+    from alphatriangle_tpu.compile_cache import get_compile_cache
+    from alphatriangle_tpu.telemetry.flight import program_family
+
+    cache_dir = get_compile_cache().cache_dir
+    sidecar_families: dict = {}
+    for sidecar in Path(cache_dir).glob("*.cost.json"):
+        try:
+            rec = _json.loads(sidecar.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("kind") == "cost":
+            fam = program_family(str(rec.get("program", "")))
+            sidecar_families.setdefault(fam, []).append(sidecar.name)
+    wanted = {"rollout", "learner", "megastep", "serve"}
+    missing = wanted - set(sidecar_families)
+    if missing:
+        print(
+            f"roofline-smoke: {cache_dir} is missing .cost.json "
+            f"sidecars for families {sorted(missing)} (found: "
+            f"{ {f: len(n) for f, n in sidecar_families.items()} })",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        "roofline-smoke: sidecars cover "
+        + ", ".join(
+            f"{f} x{len(sidecar_families[f])}" for f in sorted(wanted)
+        )
+    )
+
+    print("roofline-smoke: cli roofline attribution gate...", flush=True)
+    rc = cli_main(["roofline", RUN_NAME, "--root-dir", root])
+    if rc != 0:
+        print(
+            f"roofline-smoke: cli roofline failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+    roof = _roofline_json(cli_main, RUN_NAME, root)
+    if roof is None:
+        print(
+            "roofline-smoke: cli roofline --json unparseable",
+            file=sys.stderr,
+        )
+        return 2
+    attrib = roof.get("attribution") or {}
+    attributed = attrib.get("attributed_fraction")
+    if not isinstance(attributed, (int, float)) or attributed < 0.95:
+        print(
+            f"roofline-smoke: attributed_fraction {attributed} < 0.95 "
+            f"(gaps: {attrib.get('gaps')})",
+            file=sys.stderr,
+        )
+        return 2
+    rows = roof.get("programs") or []
+    hot = {
+        r.get("family")
+        for r in rows
+        if isinstance(r.get("count"), (int, float)) and r["count"] > 0
+    }
+    unclassified = [
+        r["program"]
+        for r in rows
+        if r.get("bound") is None or r.get("roofline_fraction") is None
+    ]
+    if not {"rollout", "learner"} <= hot or unclassified:
+        print(
+            f"roofline-smoke: hot families {sorted(f for f in hot if f)} "
+            f"(need rollout+learner); unclassified rows: {unclassified}",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"roofline-smoke: attributed {attributed:.1%} of "
+        f"{attrib.get('wall_s')}s wall "
+        f"(idle {attrib.get('chip_idle_fraction'):.1%}); "
+        f"{len(rows)} program row(s) classified"
+    )
+
+    mega_roof = _roofline_json(cli_main, mega_run, root)
+    if mega_roof is None:
+        print(
+            "roofline-smoke: cli roofline --json failed on the "
+            "megastep run",
+            file=sys.stderr,
+        )
+        return 2
+    mega_rows = [
+        r
+        for r in mega_roof.get("programs") or []
+        if r.get("family") == "megastep" and r.get("bound") is not None
+    ]
+    if not mega_rows:
+        print(
+            "roofline-smoke: megastep run has no classified megastep "
+            "row",
+            file=sys.stderr,
+        )
+        return 2
+
+    print("roofline-smoke: flight overhead gate (<1% wall)...", flush=True)
+    from alphatriangle_tpu.telemetry.ledger import iter_jsonl_records
+
+    ledger = pc.get_run_base_dir() / "metrics.jsonl"
+    flight_path = pc.get_run_base_dir() / "flight.jsonl"
+    utils = [
+        r
+        for r in iter_jsonl_records(ledger)
+        if r.get("kind") == "util"
+    ]
+    run_wall = sum(
+        r["window_s"]
+        for r in utils
+        if isinstance(r.get("window_s"), (int, float))
+    )
+    overhead = next(
+        (
+            r.get("overhead_s")
+            for r in reversed(list(iter_jsonl_records(flight_path)))
+            if r.get("kind") == "flight_overhead"
+        ),
+        None,
+    )
+    if not isinstance(overhead, (int, float)) or (
+        run_wall > 0 and overhead > 0.01 * run_wall
+    ):
+        print(
+            f"roofline-smoke: flight overhead {overhead} vs "
+            f"{run_wall:.1f}s wall — telemetry cost regressed past 1%",
+            file=sys.stderr,
+        )
+        return 2
+    idle_utils = [
+        r
+        for r in utils
+        if isinstance(r.get("chip_idle_fraction"), (int, float))
+    ]
+    dpi_utils = [
+        r
+        for r in utils
+        if isinstance(r.get("dispatches_per_iteration"), (int, float))
+    ]
+    if not idle_utils or not dpi_utils:
+        print(
+            f"roofline-smoke: {ledger} carries {len(idle_utils)} util "
+            f"record(s) with chip_idle_fraction and {len(dpi_utils)} "
+            "with dispatches_per_iteration — a gauge came unwired",
+            file=sys.stderr,
+        )
+        return 2
+
+    print("roofline-smoke: cli perf --json roofline fields...", flush=True)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["perf", RUN_NAME, "--root-dir", root, "--json"])
+    if rc != 0:
+        print(
+            f"roofline-smoke: cli perf failed (rc={rc})", file=sys.stderr
+        )
+        return rc
+    perf = _json.loads(buf.getvalue())
+    perf_missing = [
+        k
+        for k in (
+            "chip_idle_fraction",
+            "roofline_attributed_fraction",
+            "roofline_chip_idle_fraction",
+            "dispatches_per_iteration",
+        )
+        if not isinstance(perf.get(k), (int, float))
+    ]
+    if perf_missing:
+        print(
+            f"roofline-smoke: cli perf --json is missing {perf_missing}",
+            file=sys.stderr,
+        )
+        return 2
+
+    print(
+        f"roofline-smoke: cli compare vs {REFERENCE.name} "
+        f"(threshold {args.threshold:.0%})...",
+        flush=True,
+    )
+    rc = cli_main(
+        [
+            "compare",
+            RUN_NAME,
+            str(REFERENCE),
+            "--root-dir",
+            root,
+            "--threshold",
+            str(args.threshold),
+        ]
+    )
+    if rc != 0:
+        print(
+            f"roofline-smoke: cli compare failed (rc={rc})",
+            file=sys.stderr,
+        )
+        return rc
+    if args.root_dir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+    print("roofline-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,9 +103,14 @@ archive_window() {
   # in-program stat-pack records (telemetry/device_stats.py)?
   device_stats=0
   grep -q '"kind": *"device_stats"' "$run_dir/metrics.jsonl" 2>/dev/null && device_stats=1
-  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "device_stats": %s, "doctor": %s, "lint": %s}\n' \
-    "$ts" "$why" "$run_dir" "$device_stats" "$verdict" "$lint_row" >> "$runs_root/_windows/windows.jsonl"
+  # Roofline verdict (also JAX-free): where this window's wall went —
+  # compute- vs memory-bound families + chip-idle gap attribution.
+  roofline=$(timeout 60 python -m alphatriangle_tpu.cli roofline "$run_dir" --json 2>/dev/null)
+  [ -n "$roofline" ] || roofline='{"verdict": "unreadable"}'
+  printf '{"ts": "%s", "why": "%s", "run_dir": "%s", "device_stats": %s, "doctor": %s, "roofline": %s, "lint": %s}\n' \
+    "$ts" "$why" "$run_dir" "$device_stats" "$verdict" "$roofline" "$lint_row" >> "$runs_root/_windows/windows.jsonl"
   echo "$verdict" > "$dest/doctor.json"
+  echo "$roofline" > "$dest/roofline.json"
   echo "$(date +%T) window archived: $dest ($why, doctor rc=$rc)" >&2
 }
 

@@ -22,6 +22,14 @@ os.environ["ALPHATRIANGLE_AOT_CACHE_DIR"] = tempfile.mkdtemp(
     prefix="at_test_aot_"
 )
 
+# Skip the setup-time cost pre-capture (telemetry/roofline.py): it
+# lower+compiles the learner/megastep program purely for
+# `cost_analysis()`, seconds of pure overhead in every throwaway
+# training run the suite (and its subprocess drivers — children
+# inherit this) spins up. The capture path itself is covered by
+# tests/test_roofline.py and `make roofline-smoke`.
+os.environ["ALPHATRIANGLE_COST_PRECAPTURE"] = "0"
+
 # Must happen before jax import anywhere in the test process. Force CPU
 # even when the ambient environment points at a real accelerator (e.g.
 # JAX_PLATFORMS=axon): tests exercise sharding on virtual CPU devices and

@@ -73,6 +73,7 @@ class TestShardedRollout:
         shards = engine.states.step_count.addressable_shards
         assert {s.device for s in shards} == devices
 
+    @pytest.mark.slow
     def test_parity_with_unsharded_engine(self, world):
         mesh = MeshConfig(DP_SIZE=8).build_mesh()
         sharded = _make(world, mesh=mesh, seed=11)

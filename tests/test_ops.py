@@ -309,6 +309,7 @@ class TestSubtreePromote:
 
 
 class TestSearchGatherInvariance:
+    @pytest.mark.slow
     def test_search_identical_across_modes(
         self, tiny_env_config, tiny_model_config, tiny_mcts_config
     ):
@@ -365,6 +366,7 @@ class TestSearchBackupInvariance:
         np.testing.assert_array_equal(outs["xla"][0], outs["pallas"][0])
         np.testing.assert_array_equal(outs["xla"][1], outs["pallas"][1])
 
+    @pytest.mark.slow
     def test_fixed_seed_chunk_bit_identical(
         self,
         tiny_env_config,

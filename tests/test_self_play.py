@@ -77,6 +77,7 @@ class TestBasics:
         assert all(s >= 0 for s in result.episode_lengths)
         assert result.total_simulations > 0
 
+    @pytest.mark.slow
     def test_truncation_counted(self, world):
         """Games hitting MAX_EPISODE_MOVES are counted as truncated;
         natural game-overs are not."""
@@ -97,6 +98,7 @@ class TestBasics:
         assert r2.num_experiences == 0
         assert r2.num_episodes == 0
 
+    @pytest.mark.slow
     def test_staleness_tag_tracks_weights_version(self, world):
         env, fe, net, mcts_cfg = world
         engine, _ = make_engine(world)

@@ -353,6 +353,7 @@ class TestSetupWiring:
 
 
 class TestLoopEndToEnd:
+    @pytest.mark.slow
     def test_overlapped_loop_on_sharded_ring(
         self, tmp_path, tiny_env_config, tiny_model_config, tiny_mcts_config
     ):

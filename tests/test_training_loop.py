@@ -127,6 +127,7 @@ class TestLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_fused_learner_steps_run(self, tmp_path, tiny_world_configs):
         """FUSED_LEARNER_STEPS>1 completes the same run; cadences use
         crossing checks because steps advance by the group size."""
@@ -228,6 +229,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_multi_stream_producers(self, tmp_path, tiny_world_configs):
         """NUM_SELF_PLAY_WORKERS=2 runs two independent rollout
         streams into the shared queue (the reference's worker fan-out,
@@ -246,6 +248,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_all_features_compose(self, tmp_path, tiny_world_configs):
         """Cross-feature integration: Gumbel root search + playout cap
         randomization + fused learner groups + overlapped multi-stream
@@ -283,6 +286,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_replay_ratio_gate(self, tmp_path, tiny_world_configs):
         """The learner never consumes more than REPLAY_RATIO allows."""
         ratio = 0.5
@@ -299,6 +303,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_pipeline_disabled_still_completes(
         self, tmp_path, tiny_world_configs
     ):
@@ -317,6 +322,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_pipelined_fused_groups(self, tmp_path, tiny_world_configs):
         """Pipelined pump + fused groups: steps, cadences and the final
         checkpoint all land; nothing is left inflight."""
@@ -415,6 +421,7 @@ class TestAsyncLoop:
         c.stats.close()
         c.checkpoints.close()
 
+    @pytest.mark.slow
     def test_producer_respawn_recovers(
         self, tmp_path, tiny_world_configs, monkeypatch
     ):
@@ -452,6 +459,7 @@ class TestAsyncLoop:
 
 
 class TestRunnerResume:
+    @pytest.mark.slow
     def test_run_training_and_resume(self, tmp_path, tiny_world_configs):
         """VERDICT #10 bar: run, 'kill', rerun -> resumes from latest."""
         env_cfg, model_cfg, mcts_cfg = tiny_world_configs
