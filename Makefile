@@ -57,12 +57,15 @@ perf-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/perf_smoke.py
 
 # Policy-serving pipeline gate (docs/SERVING.md): `cli serve --smoke`
-# must serve >= 64 concurrent simulated sessions on CPU through batched
-# search dispatches with admit/retire churn mid-run, land per-request
-# p50/p95 move-latency records in the serve run's metrics ledger,
-# summarize them via `cli perf --json`, and hold the serve SLO rows of
-# `cli compare` against the checked-in reference. Regenerate the serve
-# rows after intentional schema changes:
+# must storm 96 simulated sessions on CPU over the {16,32,64}
+# serve-shape ladder with int8 inference ON — the micro-batcher walks
+# up >= 1 rung (64 concurrent at the top) and back down on the drain,
+# zero recompiles after the all-rung warm, zero lost requests,
+# admit/retire churn mid-run — land per-request p50/p95 move-latency
+# records plus the serve_bucket/serve_fill gauges in the serve run's
+# metrics ledger, summarize them via `cli perf --json`, and hold the
+# serve SLO rows of `cli compare` against the checked-in reference.
+# Regenerate the serve rows after intentional schema changes:
 #   $(PY) benchmarks/serve_smoke.py --write-reference
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_smoke.py

@@ -56,6 +56,11 @@ class Candidate:
     backup_update: str = "xla"  # MCTSConfig.backup_update
     per_sample: str = "xla"  # TrainConfig.PER_SAMPLE_BACKEND
     inference_precision: str = "float32"  # ModelConfig.INFERENCE_PRECISION
+    # Serve-shape ladder spec (serving/buckets.py): CSV rung list, ""
+    # meaning a single fixed rung at the plan's serve batch. A serve-
+    # side axis — it never changes training residency, so it is absent
+    # from oracle_key() (free axis: ladders share feasibility answers).
+    serve_buckets: str = ""
     # MCTSConfig.tree_reuse: NOT memory-free — reuse widens every tree
     # plane from max_simulations+1 to ~2x that many node slots, so it
     # appears in oracle_key() alongside the other residency-changing
@@ -75,6 +80,7 @@ class Candidate:
             self.backup_update,
             self.per_sample,
             self.inference_precision,
+            self.serve_buckets,
             self.tree_reuse,
         )
 
@@ -102,6 +108,7 @@ class Candidate:
             "backup_update": self.backup_update,
             "per_sample": self.per_sample,
             "inference_precision": self.inference_precision,
+            "serve_buckets": self.serve_buckets,
             "tree_reuse": self.tree_reuse,
         }
 
@@ -117,6 +124,7 @@ class Candidate:
                 (f"b-{self.backup_update}", "b-xla"),
                 (f"s-{self.per_sample}", "s-xla"),
                 (f"p-{self.inference_precision}", "p-float32"),
+                (f"sb-{self.serve_buckets}", "sb-"),
                 (f"r-{'on' if self.tree_reuse else 'off'}", "r-off"),
             )
             if tag != default
@@ -143,17 +151,21 @@ class SearchSpace:
     backup_updates: list = field(default_factory=lambda: ["xla"])
     per_samples: list = field(default_factory=lambda: ["xla"])
     precisions: list = field(default_factory=lambda: ["float32"])
+    # Serve-shape ladders ("" = fixed single rung; "64,256,1024" =
+    # the micro-batcher's rung set). Free axis for the oracle.
+    serve_bucket_ladders: list = field(default_factory=lambda: [""])
     tree_reuses: list = field(default_factory=lambda: [False])
 
     def candidates(self) -> list:
         """Every lattice point, B descending within each group so the
         dominance walk can early-exit on the first feasible lane count."""
         kernel_points = [
-            (g, bu, ps, pr, tr)
+            (g, bu, ps, pr, sb, tr)
             for g in self.descent_gathers
             for bu in self.backup_updates
             for ps in self.per_samples
             for pr in self.precisions
+            for sb in self.serve_bucket_ladders
             for tr in self.tree_reuses
         ]
         out = []
@@ -167,6 +179,7 @@ class SearchSpace:
                                 backup,
                                 sample,
                                 prec,
+                                buckets,
                                 reuse,
                             ) in kernel_points:
                                 for b in sorted(
@@ -185,6 +198,7 @@ class SearchSpace:
                                             backup_update=backup,
                                             per_sample=sample,
                                             inference_precision=prec,
+                                            serve_buckets=buckets,
                                             tree_reuse=reuse,
                                         )
                                     )
@@ -202,6 +216,7 @@ class SearchSpace:
             * len(self.backup_updates)
             * len(self.per_samples)
             * len(self.precisions)
+            * len(self.serve_bucket_ladders)
             * len(self.tree_reuses)
         )
 

@@ -38,6 +38,12 @@ class BenchPlan:
     # --serve` analyzes, and bench's serve section measures. Defaults
     # to the scale's self-play lane count (same MXU-batch family).
     serve_batch: int = 0
+    # Serve-shape ladder (serving/buckets.py): CSV rung list from
+    # BENCH_SERVE_BUCKETS, e.g. "64,256,1024". None means a single
+    # fixed rung at serve_batch. Feeds `cli warm` (every rung is
+    # AOT-warmed), `cli fit --serve` (per-rung analysis), and bench's
+    # serve A/B section (fill-vs-fixed ratio).
+    serve_buckets: "str | None" = None
     extras: dict = field(default_factory=dict)
 
 
@@ -87,6 +93,7 @@ def plan_from_tuned_preset(
         overlap_k=fused_k,
         device_replay=device_replay,
         serve_batch=int(env.get("BENCH_SERVE_SLOTS") or sp_batch),
+        serve_buckets=env.get("BENCH_SERVE_BUCKETS") or None,
         extras={"tuned_preset": str(path), "mode": mode},
     )
 
@@ -317,4 +324,5 @@ def resolve_bench_plan(
         overlap_k=overlap_k,
         device_replay=device_replay,
         serve_batch=serve_batch,
+        serve_buckets=env.get("BENCH_SERVE_BUCKETS") or None,
     )

@@ -879,6 +879,14 @@ def cmd_perf(args: argparse.Namespace) -> int:
             f"   fill {_fmt_cell(summary.get('serve_batch_fill'), ',.0f', 100.0, '%')}"
             f"   reloads {_fmt_cell(summary.get('serve_weight_reloads'), ',.0f')}"
         )
+        if summary.get("serve_bucket") is not None:
+            # Micro-batcher ladder line (serving/buckets.py): the rung
+            # the run ended on, mean wave fill, and switch count.
+            print(
+                f"  serve ladder bucket b{summary.get('serve_bucket')}"
+                f"   fill {_fmt_cell(summary.get('serve_fill'), ',.0f', 100.0, '%')}"
+                f"   switches {_fmt_cell(summary.get('serve_rung_switches'), ',.0f')}"
+            )
     if league is not None:
         print(
             f"  league       pool {_fmt_cell(summary.get('league_pool_size'), ',.0f')}"
@@ -1621,10 +1629,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
         use_gumbel=args.gumbel,
         telemetry=telemetry,
         rng_seed=args.seed,
+        ladder=args.buckets,
+    )
+    ladder_note = (
+        f", ladder {','.join(str(r) for r in service.ladder.rungs)}"
+        if args.buckets
+        else ""
     )
     say(
         f"serve: {source} net, board {env_cfg.ROWS}x{env_cfg.COLS}, "
-        f"{args.slots} slots, {args.sims} sims/move, run dir {run_dir}"
+        f"{args.slots} slots{ladder_note}, {args.sims} sims/move, "
+        f"precision {model_cfg.INFERENCE_PRECISION}, run dir {run_dir}"
     )
 
     # AOT warm start: deserialize (or compile+serialize) the serve
@@ -1642,8 +1657,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
     # program's resident arguments + dispatch transient vs the device
     # limit — answered before a session is admitted.
     if not args.no_preflight:
-        record = service.analyze(persist=True)
-        budget = serve_budget_bytes(record)
+        # Pre-flight EVERY ladder rung (a fixed-shape service is a
+        # one-rung ladder): the micro-batcher may dispatch any of
+        # them mid-stream, so the gate is the worst rung's budget.
+        record, budget = None, 0
+        for rung in service.ladder.rungs:
+            rec = service.analyze(persist=True, rung=rung)
+            b = serve_budget_bytes(rec)
+            if rec is not None and b >= budget:
+                record, budget = rec, b
         limit = None
         override = (args.limit_gb, _os.environ.get(BYTES_LIMIT_ENV, "").strip())
         if override[0] is not None:
@@ -1708,7 +1730,13 @@ def cmd_serve(args: argparse.Namespace) -> int:
             stats = run_simulated_load(
                 service,
                 total_sessions=args.sessions,
-                concurrency=args.slots,
+                # Under a ladder, demand may exceed the base rung —
+                # that sustained pressure is what drives the
+                # micro-batcher's walk-up (loadgen clamps to the
+                # ladder's top rung).
+                concurrency=(
+                    service.max_slots if args.buckets else args.slots
+                ),
                 max_moves=args.max_moves,
                 seed=args.seed + len(waves),
                 tick_every=args.tick_every,
@@ -1730,6 +1758,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "run": serve_run,
         "source": source,
         "slots": args.slots,
+        "buckets": list(service.ladder.rungs),
+        "precision": model_cfg.INFERENCE_PRECISION,
+        "rung_switches": service.rung_switches,
         "sims": args.sims,
         "waves": len(waves),
         "sessions_served": sum(w["sessions_served"] for w in waves),
@@ -1806,6 +1837,11 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         "--tick-every",
         str(args.tick_every),
     ]
+    if args.buckets:
+        # Replicas micro-batch on the SAME rung set the supervisor's
+        # quarantine walks down (serving/buckets.py — one ladder, two
+        # walkers).
+        replica_extra += ["--buckets", args.buckets]
     fleet = FleetSupervisor(
         run_dir,
         replicas=args.replicas,
@@ -1813,6 +1849,7 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         sims=args.sims,
         seed=args.seed,
         configs_dir=run_dir,
+        ladder=args.buckets,
         replica_extra_argv=replica_extra,
         policy_factory=policy_factory,
         probe_deadline_s=args.probe_deadline,
@@ -2115,6 +2152,10 @@ def cmd_fit(args: argparse.Namespace) -> int:
         # sidecar (serving/service.py; docs/SERVING.md).
         serve=args.serve,
         serve_batch=plan.serve_batch,
+        # Every ladder rung is analyzed (BENCH_SERVE_BUCKETS /
+        # serving/buckets.py): the micro-batcher can dispatch any of
+        # them, so the budget covers the whole rung set.
+        serve_buckets=plan.serve_buckets,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
     budget = report["budget"]
@@ -2808,6 +2849,11 @@ def cmd_tune(args: argparse.Namespace) -> int:
         if getattr(args, "tree_reuse", None)
         else [False]
     )
+    serve_ladders = (
+        ["" if v.strip() in ("off", "") else v.strip() for v in args.serve_buckets]
+        if getattr(args, "serve_buckets", None)
+        else [""]
+    )
     space = SearchSpace(
         geometries=geometries,
         batches=batches,
@@ -2818,6 +2864,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
         backup_updates=kernel_backends,
         per_samples=kernel_backends,
         precisions=precisions,
+        serve_bucket_ladders=serve_ladders,
         tree_reuses=tree_reuses,
     )
 
@@ -3338,6 +3385,16 @@ def main(argv: list[str] | None = None) -> int:
         help="Concurrent session slots = the compiled serve/b<B> "
         "search batch shape (default 64).",
     )
+    serve.add_argument(
+        "--buckets",
+        default=None,
+        metavar="RUNGS",
+        help="Serve-shape ladder as a CSV rung list (e.g. 16,64,256 — "
+        "serving/buckets.py). The micro-batcher walks between rungs "
+        "with sustained load; every rung is AOT-warmed up front so a "
+        "switch never recompiles. Default: a single fixed rung at "
+        "--slots.",
+    )
     serve.add_argument("--sims", type=int, default=64)
     serve.add_argument(
         "--sessions",
@@ -3428,7 +3485,17 @@ def main(argv: list[str] | None = None) -> int:
         default=8,
         metavar="B",
         help="Session slots per replica = its compiled serve/b<B> "
-        "bucket (a quarantined replica respawns onto half).",
+        "bucket (a quarantined replica respawns onto the next ladder "
+        "rung down).",
+    )
+    fleet.add_argument(
+        "--buckets",
+        default=None,
+        metavar="RUNGS",
+        help="Serve-shape ladder as a CSV rung list shared by every "
+        "replica's micro-batcher AND the quarantine walk-down "
+        "(serving/buckets.py). Default: the halving ladder under "
+        "--slots (reproduces the legacy 0.5-multiplier buckets).",
     )
     fleet.add_argument("--sims", type=int, default=4)
     fleet.add_argument("--seed", type=int, default=0)
@@ -3809,7 +3876,18 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         metavar="DTYPES",
         help="INFERENCE_PRECISION values to search (comma-separated "
-        "from float32,bfloat16). Default: float32 only.",
+        "from float32,bfloat16,int8 — int8 is weight-only per-channel "
+        "quantization, docs/KERNELS.md). Default: float32 only.",
+    )
+    tune.add_argument(
+        "--serve-buckets",
+        action="append",
+        default=None,
+        metavar="RUNGS",
+        help="Serve-shape ladders to search (repeatable; each a CSV "
+        "rung list like 64,256,1024 — serving/buckets.py, or 'off' for "
+        "the fixed single-rung shape). Serve-side free axis: ladders "
+        "share training feasibility answers. Default: off only.",
     )
     tune.add_argument(
         "--tree-reuse",

@@ -63,7 +63,9 @@ class ModelConfig(BaseModel):
     # Param dtype the INFERENCE family (rollout chunk, serve dispatch,
     # arena/eval) reads the network at; the learner family always
     # trains the f32 originals (nn/precision.py, docs/KERNELS.md).
-    INFERENCE_PRECISION: Literal["float32", "bfloat16"] = Field(
+    # "int8" is weight-only: matrix weights become int8 tensors with
+    # per-channel f32 scales, dequantized to bf16 on the forward trunk.
+    INFERENCE_PRECISION: Literal["float32", "bfloat16", "int8"] = Field(
         default="float32"
     )
 

@@ -187,9 +187,14 @@ class BatchedMCTS:
         valid when the network mass on valid actions vanishes — the
         reference's fallback, `nn/network.py:200-215`).
         """
+        from ..nn.precision import dequantize_params
+
         grids, others = jax.vmap(self.extractor.extract)(states)
+        # Int8 weight-only inference (nn/precision.py): marker-dict
+        # leaves dequantize to bf16 here, at the one place every search
+        # family evaluates the net; unquantized trees pass through.
         policy_logits, value_logits = self.model.apply(
-            variables, grids, others, train=False
+            dequantize_params(variables), grids, others, train=False
         )
         valid = jax.vmap(self.env.valid_action_mask)(states)  # (B, A)
         masked_logits = jnp.where(valid, policy_logits, -jnp.inf)

@@ -90,8 +90,10 @@ class NeuralNetwork:
         # the compile cache then dies with the instance instead of
         # pinning every instance's weights in the class-level jit cache.
         def _apply(variables, grid, other):
+            from .precision import dequantize_params
+
             policy_logits, value_logits = self.model.apply(
-                variables, grid, other, train=False
+                dequantize_params(variables), grid, other, train=False
             )
             policy_probs = jax.nn.softmax(policy_logits, axis=-1)
             values = expected_value_from_logits(value_logits, self.support)

@@ -19,6 +19,8 @@ jax into the parent process.
 """
 
 _LAZY = {
+    "BucketLadder": ".buckets",
+    "default_rungs": ".buckets",
     "PolicyService": ".service",
     "build_serve_telemetry": ".service",
     "serve_program_name": ".service",

@@ -1,0 +1,137 @@
+"""The serve-shape bucket ladder: ONE definition of the `serve/b<B>`
+shapes a deployment compiles, shared by every consumer that picks a
+serve batch shape.
+
+Why one module: the fleet supervisor's quarantine policy
+(supervise/policy.py `SERVE_SLOTS__scale: 0.5`) halves a wedging
+replica's bucket, the policy service's micro-batcher walks its
+compiled shape up under sustained load and back down on drain, `cli
+warm` precompiles the shapes a serve process may dispatch, and
+`estimate_fit --serve` budgets them. If each of those owned its own
+rung list they would drift — a quarantined replica could respawn onto
+a shape nobody warmed. `BucketLadder` is the single source of truth:
+quarantine IS a forced walk-down on this ladder, the micro-batcher's
+walk-up is the inverse move, and warm/fit enumerate `ladder.rungs`.
+
+Stdlib-only on purpose: the fleet supervisor never imports JAX
+(serving/fleet.py), so the ladder it routes `_effective_slots` through
+cannot either.
+"""
+
+from dataclasses import dataclass
+
+
+def default_rungs(base: int, *, floor: int = 1) -> tuple[int, ...]:
+    """The implicit ladder under a single `--slots` knob: geometric
+    halving from `base` down to `floor` — exactly the shapes the
+    legacy quarantine multiplier (0.5 per strike) could land on, so
+    routing it through the ladder changes no deployed behavior."""
+    base = int(base)
+    if base < 1:
+        raise ValueError(f"ladder base must be >= 1, got {base}")
+    rungs = []
+    r = base
+    while r > max(1, int(floor)):
+        rungs.append(r)
+        r = max(1, r // 2)
+    rungs.append(max(1, int(floor)) if base >= floor else base)
+    return tuple(sorted(set(rungs)))
+
+
+@dataclass(frozen=True)
+class BucketLadder:
+    """Sorted, deduplicated serve batch shapes (e.g. (64, 256, 1024)).
+
+    `rungs[i]` is a compiled `serve/b<rungs[i]>` shape; walking up or
+    down moves one index. All lookups clamp — the ladder never
+    invents a shape it doesn't own.
+    """
+
+    rungs: tuple[int, ...]
+
+    def __post_init__(self):
+        rungs = tuple(sorted({int(r) for r in self.rungs}))
+        if not rungs:
+            raise ValueError("BucketLadder needs at least one rung")
+        if rungs[0] < 1:
+            raise ValueError(f"rungs must be >= 1, got {rungs}")
+        object.__setattr__(self, "rungs", rungs)
+
+    # --- construction -------------------------------------------------
+
+    @classmethod
+    def from_spec(
+        cls, spec, base: "int | None" = None
+    ) -> "BucketLadder":
+        """Parse a ladder from a config knob: an iterable of ints, a
+        CSV string ("64,256,1024"), or None/"" (the implicit halving
+        ladder under `base`, or the single-rung ladder when no base)."""
+        if isinstance(spec, BucketLadder):
+            return spec
+        if spec is None or spec == "":
+            if base is None:
+                raise ValueError("from_spec needs a spec or a base")
+            return cls(default_rungs(base))
+        if isinstance(spec, str):
+            spec = [p for p in spec.replace(";", ",").split(",") if p.strip()]
+        rungs = tuple(int(p) for p in spec)
+        if base is not None and int(base) not in rungs:
+            rungs = rungs + (int(base),)
+        return cls(rungs)
+
+    @classmethod
+    def single(cls, slots: int) -> "BucketLadder":
+        """The degenerate one-rung ladder: fixed-shape serving."""
+        return cls((int(slots),))
+
+    # --- lookups ------------------------------------------------------
+
+    @property
+    def min_rung(self) -> int:
+        return self.rungs[0]
+
+    @property
+    def max_rung(self) -> int:
+        return self.rungs[-1]
+
+    def __contains__(self, rung) -> bool:
+        return int(rung) in self.rungs
+
+    def index(self, rung: int) -> int:
+        return self.rungs.index(int(rung))
+
+    def rung_for(self, demand: int) -> int:
+        """Smallest rung holding `demand` sessions (clamped to the top
+        rung when demand exceeds every shape)."""
+        for r in self.rungs:
+            if r >= demand:
+                return r
+        return self.max_rung
+
+    def rung_at_or_below(self, target: float) -> int:
+        """Largest rung <= target (clamped to the bottom rung): how a
+        fractional quarantine multiplier lands on a real shape."""
+        best = self.rungs[0]
+        for r in self.rungs:
+            if r <= target:
+                best = r
+        return best
+
+    def up(self, rung: int) -> int:
+        """One rung up (clamped at the top)."""
+        i = self.index(rung)
+        return self.rungs[min(i + 1, len(self.rungs) - 1)]
+
+    def down(self, rung: int) -> int:
+        """One rung down (clamped at the bottom)."""
+        i = self.index(rung)
+        return self.rungs[max(i - 1, 0)]
+
+    def walk_down(self, rung: int, strikes: int = 1) -> int:
+        """`strikes` forced steps down — the quarantine move. One
+        strike from rung R equals the legacy `SERVE_SLOTS__scale: 0.5`
+        halving on the implicit ladder (test_fleet pins this)."""
+        r = int(rung)
+        for _ in range(max(0, int(strikes))):
+            r = self.down(r)
+        return r

@@ -113,6 +113,9 @@ class ProcessReplicaHandle:
         self.proc = None
         self.generation = 0
         self.bucket: "int | None" = None
+        # Inference precision self-reported on the ready line (None
+        # until ready, and for legacy replicas that don't report it).
+        self.precision: "str | None" = None
         self.admit = True  # rolling-reload drain gate
         self.probe_ok = False
         self.ready = threading.Event()
@@ -262,6 +265,7 @@ class FleetSupervisor:
         replicas: int = 2,
         slots: int = 8,
         sims: int = 4,
+        ladder=None,
         seed: int = 0,
         configs_dir: "Path | str | None" = None,
         replica_extra_argv: "list | None" = None,
@@ -273,9 +277,17 @@ class FleetSupervisor:
         now=time.time,
         sleep=time.sleep,
     ) -> None:
+        from .buckets import BucketLadder
+
         self.run_dir = Path(run_dir)
         self.run_dir.mkdir(parents=True, exist_ok=True)
         self.slots = slots
+        # The serve-shape ladder quarantine walks replicas down
+        # (serving/buckets.py — the SAME rung set the micro-batcher
+        # and `cli warm` use; None = the implicit halving ladder under
+        # `slots`, which reproduces the legacy 0.5-multiplier buckets
+        # exactly).
+        self.ladder = BucketLadder.from_spec(ladder, base=slots)
         self.sims = sims
         self.seed = seed
         self.configs_dir = str(configs_dir) if configs_dir else ""
@@ -369,10 +381,19 @@ class FleetSupervisor:
     # --- spawning ---------------------------------------------------------
 
     def _effective_slots(self, name: str) -> int:
+        """The `serve/b<B>` rung this replica's next incarnation
+        compiles: the base bucket scaled by any quarantine multiplier
+        (supervise/policy.py `SERVE_SLOTS__scale`), then snapped DOWN
+        onto the bucket ladder — quarantine is a forced walk-down on
+        the same ladder the micro-batcher climbs, so a degraded
+        replica always lands on a shape `cli warm` precompiled
+        (test_fleet pins ladder/scale agreement)."""
         scale = float(
             self._overrides.get(name, {}).get("SERVE_SLOTS__scale", 1.0)
         )
-        return max(1, int(round(self.slots * scale)))
+        return self.ladder.rung_at_or_below(
+            max(1.0, round(self.slots * scale))
+        )
 
     def _spawn(self, handle: ProcessReplicaHandle, event: str) -> None:
         self._attempts[handle.name] += 1
@@ -433,12 +454,19 @@ class FleetSupervisor:
         telemetry/merge.py uses to place that process's monotonic
         timestamps on the shared wall-clock timeline."""
         ctx = self._spawn_ctx.get(handle.name)
+        # The replica self-reports its compiled rung + inference
+        # precision (legacy replicas omit them; every reader treats
+        # the fields as optional) — `cli watch`'s fleet line renders
+        # both, so a quarantine-halved or ladder-walked replica is
+        # visible at a glance.
+        handle.precision = msg.get("precision")
         self._event(
             "replica-ready",
             replica=handle.name,
             generation=handle.generation,
             replica_pid=msg.get("pid"),
             slots=msg.get("slots"),
+            precision=msg.get("precision"),
             warm_aot=msg.get("warm_aot"),
             t_mono=msg.get("t_mono"),
             replica_time=msg.get("time"),
@@ -450,6 +478,7 @@ class FleetSupervisor:
             "fleet-start",
             replicas=len(self.handles),
             slots=self.slots,
+            rungs=list(self.ladder.rungs),
             sims=self.sims,
         )
         for h in self.handles:
@@ -674,6 +703,8 @@ class FleetSupervisor:
             "reload_rounds": self.reload_rounds,
             "reload_recompiles": self.reload_recompiles,
             "buckets": {h.name: h.bucket for h in self.handles},
+            "precisions": {h.name: h.precision for h in self.handles},
+            "rungs": list(self.ladder.rungs),
         }
 
 

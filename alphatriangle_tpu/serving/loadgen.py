@@ -40,9 +40,13 @@ def run_simulated_load(
     """
     import jax
 
-    concurrency = min(
-        concurrency or service.sessions.slots, service.sessions.slots
-    )
+    # Concurrency is bounded by the most sessions the service can EVER
+    # hold — the ladder's top rung under a micro-batching service
+    # (serving/buckets.py), the fixed slot count otherwise. Asking for
+    # more than the current shape is exactly the sustained-demand
+    # signal that drives the ladder walk-up.
+    limit = int(getattr(service, "max_slots", service.sessions.slots))
+    concurrency = min(concurrency or service.sessions.slots, limit)
     if concurrency < 1:
         raise ValueError("concurrency must be >= 1")
     t_start = clock()

@@ -345,6 +345,13 @@ def main(argv: "list | None" = None) -> int:
     )
     p.add_argument("--name", default="replica")
     p.add_argument("--slots", type=int, default=8)
+    p.add_argument(
+        "--buckets",
+        default=None,
+        help="CSV serve-shape ladder (serving/buckets.py); the service "
+        "micro-batches across these rungs instead of the fixed --slots "
+        "shape (--slots stays the starting rung).",
+    )
     p.add_argument("--sims", type=int, default=4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tick-every", type=int, default=8)
@@ -408,6 +415,7 @@ def main(argv: "list | None" = None) -> int:
         use_gumbel=args.gumbel,
         telemetry=telemetry,
         rng_seed=args.seed,
+        ladder=args.buckets,
     )
     # AOT warm BEFORE the ready line: episode requests never pay the
     # search compile, so the storm's move latencies measure serving.
@@ -432,6 +440,11 @@ def main(argv: "list | None" = None) -> int:
             "name": args.name,
             "pid": os.getpid(),
             "slots": args.slots,
+            # Rung + precision ride the ready line so the fleet ledger
+            # (and `cli watch`'s fleet line) can show what shape and
+            # dtype each replica actually serves at.
+            "rungs": list(service.ladder.rungs),
+            "precision": model_cfg.INFERENCE_PRECISION,
             "warm_aot": bool(aot),
             **_clock_pair(),
         }

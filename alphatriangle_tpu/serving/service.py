@@ -75,7 +75,21 @@ class PolicyService:
     enqueue move requests (lock-guarded, O(1)); one caller drives
     `dispatch()` in a loop. Admission beyond the slot count raises —
     back-pressure belongs to the caller (the load generator queues,
-    an HTTP front end would 503)."""
+    an HTTP front end would 503).
+
+    With a `ladder` (serving/buckets.py), the service becomes a
+    RUNG-SWITCHING micro-batcher: the compiled dispatch shape walks UP
+    one rung when windowed batch fill sustains at/above `high_water`
+    (or immediately when an admission would not fit the current shape
+    — zero lost requests), and DOWN when fill sustains at/below
+    `low_water` and the live sessions fit the smaller shape — the
+    inverse of the fleet quarantine's forced walk-down on the same
+    ladder. Every rung is AOT-warmed by `warm()` up front, so a switch
+    between dispatches never compiles (test_serving pins the
+    compile-cache event count across a storm). A switch migrates the
+    live sessions lowest-old-slot-first (SessionSlots.migrate), clears
+    every carried subtree (`_carry_ok`; reuse never crosses bucket
+    shapes), and keeps the one-dispatch-per-wave contract untouched."""
 
     def __init__(
         self,
@@ -89,10 +103,15 @@ class PolicyService:
         rng_seed: int = 0,
         pad_seed: int = 0,
         clock=time.monotonic,
+        ladder=None,
+        high_water: float = 0.85,
+        low_water: float = 0.25,
+        sustain: int = 3,
     ):
         import jax
 
         from ..compile_cache import config_digest, get_compile_cache
+        from .buckets import BucketLadder
 
         self.env = env
         self.extractor = extractor
@@ -108,6 +127,21 @@ class PolicyService:
         # served games become training data. None = serve-only.
         self.emitter = None
         self._clock = clock
+        # The serve-shape ladder: None = the degenerate single-rung
+        # ladder (fixed-shape serving, the historical behavior, bit
+        # for bit). `slots` is the starting rung and is always a rung.
+        if ladder is None:
+            self.ladder = BucketLadder.single(slots)
+        else:
+            self.ladder = BucketLadder.from_spec(ladder, base=slots)
+        self.high_water = float(high_water)
+        self.low_water = float(low_water)
+        self.sustain = max(1, int(sustain))
+        self.rung_switches = 0
+        # Recent per-dispatch fills driving walk decisions (distinct
+        # from the tick-drained `_win_fill` SLO window).
+        self._ladder_fill: deque[float] = deque(maxlen=self.sustain)
+        self._pad_seed = int(pad_seed)
         self.sessions = SessionSlots(env, slots, pad_seed=pad_seed)
         # The serve program: the search jit wrapped for AOT executable
         # caching. The digest covers everything that shapes the program
@@ -163,18 +197,25 @@ class PolicyService:
                 return out, mcts.promote(tree, actions), reused
 
             self._carried = mcts.zero_carried(self.sessions.states)
-            self._search = get_compile_cache().wrap(
-                serve_program_name(slots),
-                jax.jit(_serve_search_reuse),
-                extra=extra,
-                serialize=not beacons_armed(),
-            )
+            self._search_fn = jax.jit(_serve_search_reuse)
         else:
-            self._search = get_compile_cache().wrap(
-                serve_program_name(slots),
-                mcts.search,
+            self._search_fn = mcts.search
+        # One CachedProgram per ladder rung, all over the SAME jitted
+        # function (batch shape is an aval, not a closure): the cache
+        # names them serve/b<rung> so flight spans / warm rows / memory
+        # sidecars attribute per shape, and a rung switch just swaps
+        # which program the dispatch calls — zero tracing, zero
+        # recompiles once warmed.
+        self._cache = get_compile_cache()
+        self._extra = extra
+        self._serialize_artifacts = not beacons_armed()
+        self._programs: dict[int, object] = {}
+        for rung in self.ladder.rungs:
+            self._programs[rung] = self._cache.wrap(
+                serve_program_name(rung),
+                self._search_fn,
                 extra=extra,
-                serialize=not beacons_armed(),
+                serialize=self._serialize_artifacts,
             )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.RLock()
@@ -207,6 +248,18 @@ class PolicyService:
 
     # --- warm start / pre-flight --------------------------------------
 
+    @property
+    def _search(self):
+        """The compiled program for the CURRENT rung (dispatch shape)."""
+        return self._programs[self.sessions.slots]
+
+    @property
+    def max_slots(self) -> int:
+        """The most sessions this service can ever hold (the ladder's
+        top rung) — admission planners size against this, not the
+        current shape (loadgen)."""
+        return self.ladder.max_rung
+
     def _serve_variables(self):
         """The variables the serve dispatch reads: the net's, cast to
         the inference precision policy (nn/precision.py). Identity
@@ -229,49 +282,148 @@ class PolicyService:
         self._cast_variables = (key, cast)
         return cast
 
-    def _sample_args(self):
+    def _sample_args_for(self, rung: int):
+        """Dispatch-identical argument avals at one rung's shape: the
+        current slot array when `rung` is the live shape, a frozen
+        padding array otherwise (shapes/dtypes are all that matter —
+        warm/analyze never execute)."""
         import jax
+        import jax.numpy as jnp
 
-        args = (
-            self._serve_variables(),
-            self.sessions.states,
-            jax.random.PRNGKey(0),
-        )
-        if self._tree_reuse:
-            args += (
-                self._carried,
-                jax.numpy.zeros(self.sessions.slots, dtype=bool),
+        rung = int(rung)
+        if rung == self.sessions.slots:
+            states = self.sessions.states
+            carried = self._carried
+        else:
+            keys = jax.random.split(
+                jax.random.PRNGKey(self._pad_seed), rung
             )
+            base = self.env.reset_batch(keys)
+            states = base.replace(
+                done=jnp.ones((rung,), dtype=base.done.dtype)
+            )
+            carried = (
+                self.mcts.zero_carried(states) if self._tree_reuse else None
+            )
+        args = (self._serve_variables(), states, jax.random.PRNGKey(0))
+        if self._tree_reuse:
+            args += (carried, jnp.zeros(rung, dtype=bool))
         return args
 
-    def warm(self) -> bool:
-        """AOT-ready the serve program for this slot shape (deserialize
-        or compile+serialize, never execute) — `cli warm`'s serve row
-        and `cli serve`'s startup both come through here."""
-        return self._search.warm(*self._sample_args())
+    def _sample_args(self):
+        return self._sample_args_for(self.sessions.slots)
 
-    def analyze(self, persist: bool = False) -> "dict | None":
-        """Memory record for the serve program (AOT analysis, never
-        executed; telemetry/memory.py). `persist=True` writes the
-        `.mem.json` sidecar beside the executable artifact."""
-        return self._search.analyze(*self._sample_args(), persist=persist)
+    def warm(self) -> bool:
+        """AOT-ready the serve program for EVERY ladder rung
+        (deserialize or compile+serialize, never execute) — `cli
+        warm`'s serve rows and `cli serve`'s startup both come through
+        here. Warming every rung up front is what makes a mid-stream
+        rung switch zero-recompile. True iff every rung is AOT-ready."""
+        ok = True
+        for rung in self.ladder.rungs:
+            ok = self.warm_rung(rung) and ok
+        return ok
+
+    def warm_rung(self, rung: int) -> bool:
+        """AOT-ready one rung's serve program (warm.py's per-rung
+        target rows)."""
+        return self._programs[int(rung)].warm(*self._sample_args_for(rung))
+
+    def analyze(
+        self, persist: bool = False, rung: "int | None" = None
+    ) -> "dict | None":
+        """Memory record for the serve program at one rung (default:
+        the current shape; AOT analysis, never executed;
+        telemetry/memory.py). `persist=True` writes the `.mem.json`
+        sidecar beside the executable artifact."""
+        r = self.sessions.slots if rung is None else int(rung)
+        return self._programs[r].analyze(
+            *self._sample_args_for(r), persist=persist
+        )
+
+    # --- the bucket ladder (serving/buckets.py) -----------------------
+
+    def _switch_rung(self, new_rung: int, reason: str) -> None:
+        """Swap the compiled dispatch shape between dispatches: migrate
+        live sessions into a `new_rung`-lane slot array (identity-
+        preserving, lowest-old-slot-first), invalidate every carried
+        subtree (a promoted tree's static shape belongs to its bucket;
+        reuse never crosses shapes), and reset the walk window. Caller
+        holds the lock."""
+        old = self.sessions.slots
+        if new_rung == old:
+            return
+        self.sessions = self.sessions.migrate(
+            new_rung, pad_seed=self._pad_seed
+        )
+        self._carry_ok = np.zeros(new_rung, dtype=bool)
+        if self._tree_reuse:
+            self._carried = self.mcts.zero_carried(self.sessions.states)
+        self._ladder_fill.clear()
+        self.rung_switches += 1
+        logger.info(
+            "serve: rung switch b%d -> b%d (%s; live=%d queue=%d)",
+            old,
+            new_rung,
+            reason,
+            self.sessions.live_count,
+            self.queue_depth,
+        )
+
+    def _maybe_walk(self) -> None:
+        """The windowed walk decision, taken between dispatches (caller
+        holds the lock): up when fill sustains at/above the high-water
+        mark, down when it sustains at/below the low-water mark AND the
+        live sessions fit the smaller shape. Mirrors the fleet
+        quarantine's walk-down on the same ladder — quarantine is this
+        move, forced."""
+        if len(self._ladder_fill) < self.sustain:
+            return
+        fill = sum(self._ladder_fill) / len(self._ladder_fill)
+        rung = self.sessions.slots
+        if fill >= self.high_water and rung < self.ladder.max_rung:
+            self._switch_rung(
+                self.ladder.up(rung), f"fill {fill:.2f} >= high-water"
+            )
+        elif fill <= self.low_water and rung > self.ladder.min_rung:
+            lower = self.ladder.down(rung)
+            if self.sessions.live_count <= lower:
+                self._switch_rung(
+                    lower, f"fill {fill:.2f} <= low-water"
+                )
 
     # --- session lifecycle --------------------------------------------
 
+    def _grow_for(self, needed: int) -> None:
+        """Demand-driven walk-up: when an admission would overflow the
+        current shape but fits a higher rung, switch BEFORE admitting —
+        a burst is never shed while the ladder has headroom (caller
+        holds the lock)."""
+        demand = self.sessions.live_count + int(needed)
+        if self.sessions.free_count >= needed or demand > self.ladder.max_rung:
+            return
+        target = self.ladder.rung_for(demand)
+        if target > self.sessions.slots:
+            self._switch_rung(target, f"admission demand {demand}")
+
     def open_session(self, reset_key=None, seed: "int | None" = None):
         """Admit one session (fresh game). Returns the Session handle.
-        Raises RuntimeError when every slot is occupied."""
+        Walks the ladder up when the current shape is full but a
+        higher rung exists; raises RuntimeError when every slot of the
+        TOP rung is occupied."""
         import jax
 
         if reset_key is None:
             reset_key = jax.random.PRNGKey(0 if seed is None else seed)
         with self._lock:
+            self._grow_for(1)
             s = self.sessions.admit(reset_key)
             self._carry_ok[s.slot] = False
             return s
 
     def open_sessions(self, reset_keys) -> list:
         with self._lock:
+            self._grow_for(len(reset_keys))
             admitted = self.sessions.admit_many(reset_keys)
             for s in admitted:
                 self._carry_ok[s.slot] = False
@@ -502,7 +654,12 @@ class PolicyService:
                 self._carry_ok = mask & ~np.asarray(dones_np, dtype=bool)
             self._win_requests += len(results)
             self._win_batch_ms.append(batch_ms)
-            self._win_fill.append(len(results) / self.sessions.slots)
+            fill = len(results) / self.sessions.slots
+            self._win_fill.append(fill)
+            self._ladder_fill.append(fill)
+            # Walk decision BETWEEN dispatches: this wave ran at the
+            # old shape; the next one may run at the new.
+            self._maybe_walk()
             if self.telemetry is not None:
                 self.telemetry.on_rollout(
                     experiences=len(results),
@@ -531,6 +688,17 @@ class PolicyService:
         snap = self.sessions.snapshot()
         stats = {
             "serve_slots": snap["slots"],
+            # The current ladder rung + instantaneous fill gauges
+            # (ledger -> Prometheus -> cli perf): serve_bucket tracks
+            # the micro-batcher's compiled shape, serve_fill the most
+            # recent dispatch's occupancy at that shape.
+            "serve_bucket": snap["slots"],
+            "serve_fill": (
+                round(float(self._win_fill[-1]), 4)
+                if self._win_fill
+                else None
+            ),
+            "serve_rung_switches": self.rung_switches,
             "serve_sessions": snap["live"],
             "serve_sessions_admitted": snap["admitted_total"],
             "serve_sessions_retired": snap["retired_total"],

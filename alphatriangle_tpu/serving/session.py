@@ -224,6 +224,56 @@ class SessionSlots:
         self.retired_total += 1
         return s.summary()
 
+    # --- rung migration (serving/buckets.py micro-batcher) -------------
+
+    def migrate(self, new_slots: int, pad_seed: int = 0) -> "SessionSlots":
+        """A new slot array of `new_slots` lanes carrying every live
+        session over — the micro-batcher's rung switch
+        (serving/service.py). Sessions keep their identity (same
+        Session objects, sids, pending requests, admitted/retired
+        totals and sid counter), and are re-packed lowest-old-slot-first
+        into slots 0..live-1: relative lane order is preserved, so a
+        deterministic admit order still yields deterministic placement
+        after any sequence of switches (the lane-isolation property
+        then makes trajectories independent of the rung the crowd
+        rides in). Device state moves in ONE gather+scatter per leaf.
+        Raises when the live sessions don't fit the new shape."""
+        import jax
+        import jax.numpy as jnp
+
+        new_slots = int(new_slots)
+        live = sorted(self._sessions.values(), key=lambda s: s.slot)
+        if len(live) > new_slots:
+            raise RuntimeError(
+                f"migrate({new_slots}): {len(live)} live sessions do "
+                f"not fit"
+            )
+        target = SessionSlots(self.env, new_slots, pad_seed=pad_seed)
+        if live:
+            old_idx = jnp.asarray(
+                [s.slot for s in live], dtype=jnp.int32
+            )
+            new_idx = jnp.asarray(
+                list(range(len(live))), dtype=jnp.int32
+            )
+            rows = jax.tree_util.tree_map(
+                lambda leaf: leaf[old_idx], self.states
+            )
+            target.states = target._admit_rows(target.states, rows, new_idx)
+        # Host bookkeeping: the target adopts this array's session
+        # identity wholesale (counters included — a migration is not
+        # an admission).
+        target._sessions = self._sessions
+        target._by_slot = {}
+        target._free = list(range(len(live), new_slots))
+        for i, s in enumerate(live):
+            s.slot = i
+            target._by_slot[i] = s
+        target._sid_counter = self._sid_counter
+        target.admitted_total = self.admitted_total
+        target.retired_total = self.retired_total
+        return target
+
     # --- the lockstep step --------------------------------------------
 
     def step(self, actions, mask):

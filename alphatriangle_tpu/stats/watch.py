@@ -185,6 +185,12 @@ class FleetWatchState:
 
     #: replica name -> last lifecycle status (see _FLEET_STATUS).
     replicas: dict = field(default_factory=dict)
+    #: replica name -> current serve rung (slot count). Spawn/ready/
+    #: readmit events carry `slots`, so a quarantine-halved or
+    #: ladder-walked replica shows its real shape here.
+    rungs: dict = field(default_factory=dict)
+    #: replica name -> inference precision ("int8"/"bfloat16"/...).
+    precisions: dict = field(default_factory=dict)
     #: newest router admission level (requests in flight at the router).
     inflight: "int | None" = None
     sheds: int = 0
@@ -219,6 +225,11 @@ class FleetWatchState:
         status = _FLEET_STATUS.get(event)
         if status is not None and name:
             self.replicas[str(name)] = status
+            # Legacy records carry neither field; fold only what's there.
+            if isinstance(rec.get("slots"), int):
+                self.rungs[str(name)] = rec["slots"]
+            if isinstance(rec.get("precision"), str):
+                self.precisions[str(name)] = rec["precision"]
         if isinstance(rec.get("inflight"), int):
             self.inflight = rec["inflight"]
         if event == "shed":
@@ -271,6 +282,19 @@ def fleet_line(state: FleetWatchState) -> "str | None":
         f"   hedges {state.hedges:,} ({state.hedge_wins:,} won)"
         f"   deaths {state.deaths:,}"
     )
+    if state.rungs or state.precisions:
+        # One segment per replica that reported a shape: "r0 up b4 int8".
+        # Fleets started before rung/precision reporting render nothing
+        # extra here (legacy fleet.jsonl stays byte-identical above).
+        segs = []
+        for name in sorted(set(state.rungs) | set(state.precisions)):
+            seg = f"{name} {state.replicas.get(name, '?')}"
+            if name in state.rungs:
+                seg += f" b{state.rungs[name]}"
+            if name in state.precisions:
+                seg += f" {state.precisions[name]}"
+            segs.append(seg)
+        line += "\n  replicas     " + "   ".join(segs)
     d = state.last_decision
     if d:
         parts = [f"last {d.get('event')}"]

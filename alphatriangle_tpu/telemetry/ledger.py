@@ -233,6 +233,10 @@ _PROM_HELP = {
     "serve_queue_wait_ms_p95": "Queue wait p95 this window, ms",
     "serve_batch_fill": "Real sessions per dispatch / slot count",
     "serve_weight_reloads": "Hot weight reloads served so far",
+    # Bucket-ladder micro-batcher gauges (serving/buckets.py).
+    "serve_bucket": "Current serve-shape ladder rung (slot count)",
+    "serve_fill": "Latest dispatch wave fill (drives rung walking)",
+    "serve_rung_switches": "Ladder rung switches since startup",
     # Device-telemetry plane gauges (telemetry/device_stats.py): the
     # loop mirrors the latest stat-pack fold onto its util records.
     "root_visit_entropy": "Mean MCTS root visit entropy, nats (stat-pack)",

@@ -455,6 +455,7 @@ def estimate_fit(
     megastep: bool = False,
     serve: bool = False,
     serve_batch: "int | None" = None,
+    serve_buckets=None,
     programs: "set[str] | None" = None,
     progress=None,
 ) -> dict:
@@ -480,7 +481,10 @@ def estimate_fit(
     policy service's `serve/b<B>` search program (serving/service.py;
     B = `serve_batch`, default the self-play lane count) and persists
     its `.mem.json` sidecar — the OOM pre-flight `cli serve` runs
-    before occupying a chip.
+    before occupying a chip. `serve_buckets` (a serving/buckets.py
+    ladder spec) analyzes EVERY rung's program: the micro-batcher may
+    dispatch any of them, so the pre-flight must budget the whole
+    ladder, and each rung gets its own sidecar pair.
     """
     from ..env.engine import TriangleEnv
     from ..features.core import get_feature_extractor
@@ -641,17 +645,21 @@ def estimate_fit(
             serve_mcts = engine.mcts
         service = PolicyService(
             env, extractor, net, serve_mcts, slots=slots,
-            use_gumbel=serve_gumbel,
+            use_gumbel=serve_gumbel, ladder=serve_buckets,
         )
-        targets.append(
-            (
-                serve_program_name(slots),
-                # persist=True: the serve sidecar survives into the
-                # cache dir so a later `cli serve` pre-flight reads it
-                # without re-lowering.
-                lambda: service.analyze(persist=True),
+        # One analysis per ladder rung (a fixed-shape service is a
+        # one-rung ladder): the micro-batcher dispatches whichever
+        # rung fits demand, so the budget must cover all of them.
+        # persist=True: each rung's sidecar survives into the cache
+        # dir so a later `cli serve` pre-flight reads it without
+        # re-lowering.
+        for rung in service.ladder.rungs:
+            targets.append(
+                (
+                    serve_program_name(rung),
+                    lambda r=rung: service.analyze(persist=True, rung=r),
+                )
             )
-        )
     if programs:
         targets = [
             (label, fn)

@@ -444,6 +444,12 @@ def summarize_utilization(
             "serve_slots": last.get("serve_slots"),
             "serve_batch_fill": _mean(numeric("serve_batch_fill")),
             "serve_weight_reloads": last.get("serve_weight_reloads"),
+            # Bucket-ladder micro-batcher (serving/buckets.py): the
+            # rung the service ended on, the windowed wave fill that
+            # drives rung walking, and how many switches the run made.
+            "serve_bucket": last.get("serve_bucket"),
+            "serve_fill": _mean(numeric("serve_fill")),
+            "serve_rung_switches": last.get("serve_rung_switches"),
         }
     # Device-stats gauges mirrored onto util records by the loop's
     # iteration tail / serve tick (telemetry/device_stats.py). Absent

@@ -246,10 +246,20 @@ def warm_bench_programs(
             serve_mcts,
             slots=plan.serve_batch,
             use_gumbel=serve_gumbel,
+            ladder=plan.serve_buckets,
         )
-        targets.append(
-            (f"serve/b{plan.serve_batch}", serve_service.warm)
-        )
+        # One row per ladder rung (serving/buckets.py): the
+        # micro-batcher promises zero-recompile rung switches, which
+        # only holds if EVERY rung's program is warmed up front — for
+        # the active inference precision (the precision digest keys the
+        # cache entries apart).
+        for rung in serve_service.ladder.rungs:
+            targets.append(
+                (
+                    f"serve/b{rung}",
+                    lambda r=rung: serve_service.warm_rung(r),
+                )
+            )
     if programs:
         targets = [
             (name, fn)

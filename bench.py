@@ -1096,10 +1096,23 @@ def run_bench(smoke: bool, seconds: float) -> dict:
     # metrics `cli perf` summarizes from a real serve run's ledger and
     # `cli compare` gates. BENCH_SERVE=0 skips.
     if os.environ.get("BENCH_SERVE", "1") != "0":
+        from alphatriangle_tpu.nn.precision import (
+            cast_params_for_inference,
+            quantized_param_bytes,
+        )
         from alphatriangle_tpu.serving import (
             PolicyService,
             run_simulated_load,
         )
+
+        def serve_param_bytes(cfg) -> int:
+            """Bytes of weights one serve wave reads from HBM under
+            `cfg`'s inference precision policy (nn/precision.py)."""
+            return int(
+                quantized_param_bytes(
+                    cast_params_for_inference(net.variables, cfg)
+                )
+            )
 
         serve_slots = plan.serve_batch
         serve_gumbel = (
@@ -1119,6 +1132,7 @@ def run_bench(smoke: bool, seconds: float) -> dict:
         serve_service = PolicyService(
             env, extractor, net, serve_mcts,
             slots=serve_slots, use_gumbel=serve_gumbel,
+            ladder=plan.serve_buckets,
         )
         log(f"bench: warming serve/b{serve_slots}...")
         t0 = time.time()
@@ -1162,8 +1176,119 @@ def run_bench(smoke: bool, seconds: float) -> dict:
             "queue_wait_ms_p95": slo["serve_queue_wait_ms_p95"],
             "batch_ms_p50": slo["serve_batch_ms_p50"],
             "batch_fill": slo["serve_batch_fill"],
+            "precision": model_cfg.INFERENCE_PRECISION,
+            "buckets": list(serve_service.ladder.rungs),
+            "rung_switches": serve_service.rung_switches,
+            "param_bytes": serve_param_bytes(model_cfg),
         }
         log(f"bench: serve {serve_section}")
+
+        def serve_arm(precision: str, ladder_spec) -> dict:
+            """One alternate serve arm: same weights and traffic shape
+            as the main section, different inference precision and/or
+            bucket ladder — the paired-measurement A/B the serve
+            speedup/fill ratios are computed from."""
+            from alphatriangle_tpu.features.core import (
+                get_feature_extractor,
+            )
+            from alphatriangle_tpu.nn.network import NeuralNetwork
+
+            model_arm = model_cfg.model_copy(
+                update={"INFERENCE_PRECISION": precision}
+            )
+            extractor_arm = get_feature_extractor(env, model_arm)
+            net_arm = NeuralNetwork(
+                model_arm, env_cfg, seed=0, variables=net.variables
+            )
+            if serve_gumbel:
+                from alphatriangle_tpu.mcts import GumbelMCTS
+
+                mcts_arm = GumbelMCTS(
+                    env, extractor_arm, net_arm.model, mcts_cfg,
+                    net_arm.support, exploit=True,
+                )
+            else:
+                from alphatriangle_tpu.mcts import BatchedMCTS
+
+                mcts_arm = BatchedMCTS(
+                    env, extractor_arm, net_arm.model, mcts_cfg,
+                    net_arm.support,
+                )
+            svc = PolicyService(
+                env, extractor_arm, net_arm, mcts_arm,
+                slots=serve_slots, use_gumbel=serve_gumbel,
+                ladder=ladder_spec,
+            )
+            svc.warm()
+            stats = run_simulated_load(
+                svc,
+                total_sessions=serve_slots + max(8, serve_slots // 2),
+                max_moves=8 if smoke else 32,
+                seed=0,
+                max_dispatches=4000,
+            )
+            arm_slo = svc.serve_stats(drain=False)
+            return {
+                "precision": precision,
+                "buckets": list(svc.ladder.rungs),
+                "requests_per_sec": stats["moves_per_sec"],
+                "batch_fill": arm_slo["serve_batch_fill"],
+                "rung_switches": svc.rung_switches,
+                "param_bytes": serve_param_bytes(model_arm),
+            }
+
+        # Precision A/B (BENCH_SERVE_PRECISION=int8): the named
+        # precision arm against a bf16 baseline arm on identical
+        # weights and traffic — speedup_vs_bf16 is the serve fast
+        # path's headline, param_bytes_ratio the HBM-read reduction
+        # the int8 weight tensors buy.
+        ab_precision = os.environ.get("BENCH_SERVE_PRECISION")
+        if ab_precision:
+            arm = serve_arm(ab_precision, plan.serve_buckets)
+            base = (
+                serve_section
+                if model_cfg.INFERENCE_PRECISION == "bfloat16"
+                else serve_arm("bfloat16", plan.serve_buckets)
+            )
+            serve_section["precision_ab"] = {
+                "arm": arm,
+                "baseline_precision": "bfloat16",
+                "baseline_requests_per_sec": base["requests_per_sec"],
+                "speedup_vs_bf16": (
+                    round(
+                        arm["requests_per_sec"]
+                        / base["requests_per_sec"],
+                        3,
+                    )
+                    if base["requests_per_sec"]
+                    else None
+                ),
+                "param_bytes_ratio": (
+                    round(arm["param_bytes"] / base["param_bytes"], 3)
+                    if base["param_bytes"]
+                    else None
+                ),
+            }
+            log(f"bench: serve precision A/B {serve_section['precision_ab']}")
+        # Bucket-ladder A/B (BENCH_SERVE_BUCKETS=...): the laddered
+        # main section against a fixed single-rung arm — fill_vs_fixed
+        # > 1 means the micro-batcher's rung walking kept waves fuller
+        # than the fixed flagship shape under the same churn.
+        if plan.serve_buckets:
+            fixed = serve_arm(model_cfg.INFERENCE_PRECISION, None)
+            serve_section["buckets_ab"] = {
+                "fixed": fixed,
+                "fill_vs_fixed": (
+                    round(
+                        serve_section["batch_fill"]
+                        / fixed["batch_fill"],
+                        3,
+                    )
+                    if fixed["batch_fill"]
+                    else None
+                ),
+            }
+            log(f"bench: serve buckets A/B {serve_section['buckets_ab']}")
         extra["serve"] = serve_section
     log(f"bench: flops/mfu {extra['flops']}")
     return snapshot(None)

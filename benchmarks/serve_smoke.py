@@ -4,16 +4,25 @@
 accelerator, that the policy-serving front end (docs/SERVING.md) works
 end to end:
 
-1. a run dir with the test-sized world's configs.json is staged, and
-   `cli serve --smoke` serves >= 64 concurrent simulated sessions
-   through batched search dispatches — sessions admitted AND retired
-   mid-run (total sessions > slot count forces churn), AOT warm start
-   and the OOM pre-flight on the way up;
+1. a run dir with the test-sized world's configs.json is staged —
+   with int8 weight-only inference ON (`INFERENCE_PRECISION="int8"`,
+   nn/precision.py) — and `cli serve --smoke` storms the serve-shape
+   ladder (`--buckets 16,32,64`, serving/buckets.py): the burst of
+   96 sessions against a 16-slot base rung drives the micro-batcher
+   up >= 1 rung (to 64 concurrent at the top) and the drain walks it
+   back down; sessions admit AND retire mid-run, AOT warm start (every
+   rung) and the OOM pre-flight (every rung) on the way up. Gates:
+   every rung switch is zero-recompile (the compile-cache event count
+   stays at exactly one entry per rung — the warm), and zero requests
+   are lost (every session serves to completion);
 2. the serve run's `metrics.jsonl` must carry `kind: "util"` records
    with per-request latency SLO fields (`serve_move_latency_ms_p50/
-   p95`, `serve_queue_wait_ms_*`, `serve_requests_per_sec`);
-3. `cli perf <serve_run> --json` must summarize them (exit 2 = the
-   ledger schema broke);
+   p95`, `serve_queue_wait_ms_*`, `serve_requests_per_sec`) plus the
+   ladder gauges (`serve_bucket`, `serve_fill`), and the folded
+   buckets must show the walk (max above the base rung, final below
+   the max);
+3. `cli perf <serve_run> --json` must summarize them, serve_bucket /
+   serve_fill included (exit 2 = the ledger schema broke);
 4. `cli compare <serve_run> benchmarks/perf_reference_cpu_smoke.json
    --metrics serve_move_latency_ms_p95,serve_requests_per_sec` gates
    the serve SLO rows against the checked-in reference. The threshold
@@ -50,7 +59,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("ALPHATRIANGLE_PEAK_TFLOPS", "1.0")
 
 SERVE_METRICS = "serve_move_latency_ms_p95,serve_requests_per_sec"
-SLOTS = 64  # >= 64 concurrent sessions (the acceptance bar)
+BASE_RUNG = 16  # starting serve shape — the burst must outgrow it
+BUCKETS = "16,32,64"  # the ladder the storm walks (serving/buckets.py)
+SLOTS = 64  # top rung: >= 64 concurrent sessions (the acceptance bar)
 SESSIONS = 96  # > SLOTS forces admit/retire churn mid-run
 
 
@@ -88,6 +99,12 @@ def main() -> int:
 
     root = args.root_dir or tempfile.mkdtemp(prefix="at_serve_smoke_")
     env_cfg, model_cfg, _mcts_cfg, _train_cfg = tiny_configs()
+    # int8 weight-only inference ON (nn/precision.py): the smoke
+    # proves the quantized serve path end to end on CPU — per-channel
+    # int8 weights + f32 scales dispatch through every ladder rung.
+    model_cfg = model_cfg.model_copy(
+        update={"INFERENCE_PRECISION": "int8"}
+    )
 
     # Stage a run dir whose configs.json pins the tiny world, so
     # `cli serve --run-name` serves it instead of the flagship net.
@@ -101,10 +118,14 @@ def main() -> int:
     )
 
     print(
-        f"serve-smoke: serving {SESSIONS} sessions over {SLOTS} slots "
+        f"serve-smoke: storming {SESSIONS} sessions over the "
+        f"{{{BUCKETS}}} ladder (base rung {BASE_RUNG}, int8) "
         f"under {root}...",
         flush=True,
     )
+    from alphatriangle_tpu.compile_cache import get_compile_cache
+
+    events_before = len(get_compile_cache().stats()["events"])
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         rc = cli_main(
@@ -113,7 +134,8 @@ def main() -> int:
                 "--smoke",
                 "--run-name", RUN_NAME,
                 "--root-dir", root,
-                "--slots", str(SLOTS),
+                "--slots", str(BASE_RUNG),
+                "--buckets", BUCKETS,
                 "--sessions", str(SESSIONS),
                 "--sims", "4",
                 "--max-moves", "40",
@@ -126,6 +148,8 @@ def main() -> int:
         print(f"serve-smoke: cli serve failed (rc={rc})", file=sys.stderr)
         return rc
     report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    # Zero lost requests: every session of the burst served to
+    # completion despite the mid-stream rung switches.
     if report["sessions_served"] < SESSIONS:
         print(
             f"serve-smoke: only {report['sessions_served']} of "
@@ -138,6 +162,37 @@ def main() -> int:
     if report["sessions_served"] <= SLOTS:
         print("serve-smoke: no churn exercised", file=sys.stderr)
         return 1
+    # Ladder walk proof, part 1 (the service's own counter): the burst
+    # must force at least one walk-up and the drain one walk-down.
+    if report.get("rung_switches", 0) < 2:
+        print(
+            f"serve-smoke: only {report.get('rung_switches')} rung "
+            "switch(es) — the storm never walked the ladder",
+            file=sys.stderr,
+        )
+        return 1
+    # Zero-recompile gate: after the up-front all-rung warm, rung
+    # switches must never touch the compiler — the compile-cache event
+    # log (one entry per compile/deserialize, never per dispatch) may
+    # hold exactly one entry per serve rung for this run.
+    serve_events = [
+        e
+        for e in get_compile_cache().stats()["events"][events_before:]
+        if str(e.get("program", "")).startswith("serve/b")
+    ]
+    rungs = len(BUCKETS.split(","))
+    if len(serve_events) != rungs:
+        print(
+            f"serve-smoke: {len(serve_events)} serve compile events for "
+            f"{rungs} rungs — a rung switch recompiled: {serve_events}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"serve-smoke: {report['rung_switches']} rung switches, "
+        f"{len(serve_events)} compiles for {rungs} rungs (zero "
+        "recompiles after warm)"
+    )
 
     serve_run = f"serve_{RUN_NAME}"
     serve_pc = PersistenceConfig(ROOT_DATA_DIR=root, RUN_NAME=serve_run)
@@ -159,9 +214,45 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    # Ladder walk proof, part 2 (the ledger's view): every util record
+    # carries the serve_bucket/serve_fill gauges, the folded buckets
+    # climb above the base rung, and the final record sits below the
+    # max (the drain walked back down).
+    buckets_seen = [
+        r.get("serve_bucket")
+        for r in lat_records
+        if isinstance(r.get("serve_bucket"), int)
+    ]
+    fills_seen = [
+        r.get("serve_fill")
+        for r in lat_records
+        if isinstance(r.get("serve_fill"), (int, float))
+    ]
+    if not buckets_seen or not fills_seen:
+        print(
+            "serve-smoke: ledger util records lack serve_bucket/"
+            "serve_fill gauges",
+            file=sys.stderr,
+        )
+        return 2
+    if max(buckets_seen) <= BASE_RUNG:
+        print(
+            f"serve-smoke: ledger never saw a rung above the base "
+            f"({sorted(set(buckets_seen))})",
+            file=sys.stderr,
+        )
+        return 1
+    if buckets_seen[-1] >= max(buckets_seen):
+        print(
+            f"serve-smoke: final rung {buckets_seen[-1]} never walked "
+            f"back down from the max {max(buckets_seen)}",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"serve-smoke: {len(lat_records)} ledger record(s) with "
-        "per-request latency fields"
+        f"per-request latency fields; rungs {sorted(set(buckets_seen))}, "
+        f"final {buckets_seen[-1]}"
     )
 
     print("serve-smoke: cli perf --json (schema gate)...", flush=True)
@@ -176,6 +267,8 @@ def main() -> int:
         "serve_move_latency_ms_p50",
         "serve_move_latency_ms_p95",
         "serve_requests_per_sec",
+        "serve_bucket",
+        "serve_fill",
     ):
         if not isinstance(summary.get(key), (int, float)):
             print(

@@ -121,14 +121,16 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # BEFORE spending the window on the real command — on a warm cache
     # this is seconds; cold, it front-loads the ~minute-per-program
     # compiles so the sweep's sections start measuring immediately.
-    # `warm auto` covers the policy-serving shape too (`serve/b<B>`,
-    # reported alongside megastep/t·_k· in the warm summary), so a
-    # `cli serve` brought up in the same window starts answering in
-    # ~0.5s instead of burning it on a search compile (docs/SERVING.md).
-    # Best-effort: a warm failure (or a wedge mid-warm) must not stop
-    # the sweep attempt.
+    # `warm auto` covers the policy-serving shapes too: with
+    # BENCH_SERVE_BUCKETS set, EVERY rung of the serve-shape ladder
+    # (`serve/b<rung>` per rung, serving/buckets.py) is warmed for the
+    # active inference precision, so both a `cli serve` startup and
+    # its mid-stream micro-batcher rung switches are zero-recompile in
+    # the window (docs/SERVING.md). Best-effort: a warm failure (or a
+    # wedge mid-warm) must not stop the sweep attempt.
     if [ "$warm_s" -gt 0 ]; then
-      echo "$(date +%T) chip healthy; warming compile caches (<=${warm_s}s)" >&2
+      rung_note=${BENCH_SERVE_BUCKETS:+" serve rungs {$BENCH_SERVE_BUCKETS}"}
+      echo "$(date +%T) chip healthy; warming compile caches (<=${warm_s}s)$rung_note" >&2
       timeout "$warm_s" python -m alphatriangle_tpu.cli warm auto >&2 \
         || echo "$(date +%T) warm incomplete (continuing)" >&2
     fi

@@ -647,6 +647,68 @@ class TestFleetSupervisor:
         assert h.routable
         assert fleet.readmissions == 2
 
+    def test_quarantine_and_ladder_cannot_drift(self, tmp_path):
+        """Drift regression (fleet.py `_effective_slots`): quarantine's
+        `SERVE_SLOTS__scale` multiplier and the micro-batcher both
+        derive their rungs from serving/buckets.py, so one quarantine
+        strike must land EXACTLY one `walk_down` on the shared ladder —
+        a shape `cli warm`/PolicyService precompiled — for any base
+        slot count and any explicit `--buckets` spec."""
+        from alphatriangle_tpu.serving.buckets import (
+            BucketLadder,
+            default_rungs,
+        )
+
+        clock = FakeClock(t=1_000.0)
+        for slots in (1, 3, 5, 8, 16, 64):
+            fleet = FleetSupervisor(
+                tmp_path / f"fleet_b{slots}",
+                replicas=1,
+                slots=slots,
+                popen=fleet_popen([]),
+                now=clock,
+                sleep=lambda s: None,
+            )
+            name = fleet.handles[0].name
+            # The implicit ladder under a bare --slots knob is the
+            # halving ladder — the legacy 0.5-multiplier bucket set.
+            assert fleet.ladder.rungs == default_rungs(slots)
+            # Healthy replica: the base rung itself.
+            assert fleet._effective_slots(name) == slots
+            # One strike (scale 0.5) == one forced walk-down, exactly.
+            fleet._overrides[name] = {"SERVE_SLOTS__scale": 0.5}
+            assert fleet._effective_slots(name) == fleet.ladder.walk_down(
+                slots
+            )
+            # Two strikes (0.25) keep agreeing, and the degraded bucket
+            # is always a rung the ladder owns (a warmable shape).
+            fleet._overrides[name] = {"SERVE_SLOTS__scale": 0.25}
+            two = fleet._effective_slots(name)
+            assert two == fleet.ladder.walk_down(slots, strikes=2)
+            assert two in fleet.ladder
+            # summary() advertises the shared rung set (cli watch's
+            # fleet line reads it).
+            assert fleet.summary()["rungs"] == list(fleet.ladder.rungs)
+
+        # An explicit --buckets spec flows into quarantine too: the
+        # strike snaps DOWN onto the CUSTOM rungs, not powers of two.
+        fleet = FleetSupervisor(
+            tmp_path / "fleet_custom",
+            replicas=1,
+            slots=48,
+            ladder="12,48,96",
+            popen=fleet_popen([]),
+            now=clock,
+            sleep=lambda s: None,
+        )
+        name = fleet.handles[0].name
+        assert fleet.ladder == BucketLadder((12, 48, 96))
+        fleet._overrides[name] = {"SERVE_SLOTS__scale": 0.5}
+        # 48 * 0.5 = 24 is NOT a rung: rung_at_or_below snaps to 12 —
+        # the same answer as one walk_down from the base rung.
+        assert fleet._effective_slots(name) == 12
+        assert fleet._effective_slots(name) == fleet.ladder.walk_down(48)
+
 
 # --- perf fold (cli perf / cli compare fleet rows) -----------------------
 

@@ -485,3 +485,95 @@ class TestInferencePrecision:
         assert (
             abs(float(scores["bf16"].mean() - scores["f32"].mean())) <= 3.0
         )
+
+    def test_int8_round_trip_within_per_channel_tolerance(
+        self, tiny_env_config, tiny_model_config
+    ):
+        """Weight-only int8 (nn/precision.py): every floating matrix
+        leaf becomes {int8 q, per-channel f32 scale}; dequantization
+        must land within one per-channel scale unit of the original
+        (0.5 from symmetric rounding + ~0.5 from the bf16 dequant
+        target), and the quantized tree must read far fewer bytes."""
+        from alphatriangle_tpu.nn.precision import (
+            dequantize_params,
+            is_quantized_leaf,
+            quantize_params_for_inference,
+            quantized_param_bytes,
+        )
+
+        _env, _fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        q = quantize_params_for_inference(net.variables)
+        q_leaves = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(
+                q, is_leaf=is_quantized_leaf
+            )
+            if is_quantized_leaf(leaf)
+        ]
+        assert q_leaves, "no matrix leaf was quantized"
+        for leaf in q_leaves:
+            assert leaf["q"].dtype == jnp.int8
+            assert leaf["scale"].dtype == jnp.float32
+        deq = dequantize_params(q)
+        flat_orig = jax.tree_util.tree_leaves(net.variables)
+        flat_deq = jax.tree_util.tree_leaves(deq)
+        checked = 0
+        for orig, got in zip(flat_orig, flat_deq):
+            if orig.ndim < 2 or not jnp.issubdtype(
+                orig.dtype, jnp.floating
+            ):
+                continue
+            scale = jnp.max(
+                jnp.abs(orig.astype(jnp.float32)),
+                axis=tuple(range(orig.ndim - 1)),
+                keepdims=True,
+            ) / 127.0
+            err = jnp.abs(
+                got.astype(jnp.float32) - orig.astype(jnp.float32)
+            )
+            assert float(jnp.max(err / jnp.maximum(scale, 1e-12))) <= 1.0
+            checked += 1
+        assert checked == len(q_leaves)
+        # The HBM-read win the quantization exists for: int8 weights +
+        # per-channel scales must read far fewer bytes than f32.
+        f32_bytes = quantized_param_bytes(net.variables)
+        int8_bytes = quantized_param_bytes(q)
+        assert int8_bytes < f32_bytes / 2
+
+    def test_int8_fixed_seed_arena_within_gate(
+        self, tiny_env_config, tiny_model_config, tiny_mcts_config
+    ):
+        """The same Elo-neutrality gate as bf16, for the int8 path:
+        paired fixed-seed greedy games under f32 vs quantized weights
+        must score within tolerance (the search dequantizes marker
+        leaves at its evaluate choke point, mcts/search.py)."""
+        from alphatriangle_tpu.arena import greedy_mcts_policy, play
+        from alphatriangle_tpu.nn.precision import cast_params_for_inference
+
+        env, fe, net = _tiny_net(tiny_env_config, tiny_model_config)
+        cfg = tiny_mcts_config.model_copy(update={"wave_noise_scale": 0.0})
+        mcts = BatchedMCTS(env, fe, net.model, cfg, net.support)
+        int8_cfg = tiny_model_config.model_copy(
+            update={"INFERENCE_PRECISION": "int8"}
+        )
+
+        class _Net:
+            def __init__(self, variables):
+                self.variables = variables
+
+        scores = {}
+        for name, variables in (
+            ("f32", net.variables),
+            ("int8", cast_params_for_inference(net.variables, int8_cfg)),
+        ):
+            s, _, _ = play(
+                env,
+                greedy_mcts_policy(_Net(variables), mcts),
+                games=4,
+                max_moves=8,
+                seed=21,
+            )
+            scores[name] = s
+        assert (
+            abs(float(scores["int8"].mean() - scores["f32"].mean())) <= 3.0
+        )

@@ -86,11 +86,19 @@ class TestSessionSlots:
         assert (after.step_count[[0, 2]] == before.step_count[[0, 2]] + 1).all()
 
 
-def drive_session(service, reset_key, dispatch_keys, churn=False, seed=7):
+def drive_session(
+    service,
+    reset_key,
+    dispatch_keys,
+    churn=False,
+    seed=7,
+    switch=None,
+):
     """Admit ONE tracked session (slot 0) and drive it to completion
     with a fixed dispatch-key sequence; optionally churn other
-    sessions around it. Returns the tracked session's (actions,
-    scores) trajectory."""
+    sessions around it. `switch=(i, rung)` forces a ladder rung switch
+    after dispatch i (serving/buckets.py). Returns the tracked
+    session's (actions, scores) trajectory."""
     tracked = service.open_session(reset_key)
     assert tracked.slot == 0
     others = []
@@ -125,6 +133,8 @@ def drive_session(service, reset_key, dispatch_keys, churn=False, seed=7):
                 )
                 for o in fresh:
                     service.request_move(o.sid)
+        if switch is not None and i == switch[0]:
+            service._switch_rung(switch[1], "test")
         if mine["done"]:
             break
     service.close_session(tracked.sid)
@@ -319,6 +329,123 @@ class TestPolicyService:
         from alphatriangle_tpu.telemetry.memory import serve_budget_bytes
 
         assert serve_budget_bytes(record) > 0
+
+
+class TestBucketLadder:
+    """The serve-shape ladder micro-batcher (serving/buckets.py +
+    PolicyService._maybe_walk): rung walking under load, lane isolation
+    and carried-tree invalidation across switches, zero recompiles."""
+
+    def test_ladder_and_quarantine_share_rungs(self):
+        """serving/buckets.py is the single rung-set definition: the
+        default ladder reproduces the legacy halving buckets exactly."""
+        from alphatriangle_tpu.serving import BucketLadder, default_rungs
+
+        assert default_rungs(8) == (1, 2, 4, 8)
+        ladder = BucketLadder.from_spec("16,4,8,4", base=16)
+        assert ladder.rungs == (4, 8, 16)
+        assert ladder.rung_for(5) == 8
+        assert ladder.rung_for(99) == 16  # clamped to the top
+        assert ladder.rung_at_or_below(15) == 8
+        assert ladder.walk_down(16) == 8
+
+    def test_storm_walks_up_down_without_recompiling(self, serve_world):
+        """The acceptance storm: a burst against a 2-slot base rung
+        walks the micro-batcher up, the drain walks it back down, no
+        request is lost, each wave is exactly one program dispatch,
+        and — every rung having been warmed up front — no switch ever
+        touches the compiler (compile-cache event count pinned)."""
+        from alphatriangle_tpu.compile_cache import get_compile_cache
+
+        env, fe, net, mcts = serve_world
+        service = PolicyService(
+            env, fe, net, mcts, slots=2, ladder="2,4,8", sustain=2
+        )
+        assert service.ladder.rungs == (2, 4, 8)
+        assert service.max_slots == 8
+        service.warm()
+
+        def serve_events() -> int:
+            return sum(
+                1
+                for e in get_compile_cache().stats()["events"]
+                if str(e.get("program", "")).startswith("serve/b")
+            )
+
+        events_after_warm = serve_events()
+        rungs_seen = []
+        stats = run_simulated_load(
+            service,
+            total_sessions=20,
+            concurrency=8,
+            max_moves=6,
+            seed=3,
+            reload_hook=lambda svc, _d: rungs_seen.append(
+                svc.sessions.slots
+            ),
+        )
+        assert stats["sessions_served"] == 20  # zero lost requests
+        assert service.rung_switches >= 2
+        assert max(rungs_seen) > 2  # walked up under the burst
+        assert rungs_seen[-1] < max(rungs_seen)  # and back down on drain
+        assert serve_events() == events_after_warm  # zero recompiles
+        assert service.dispatch_count == stats["dispatches"]
+
+    def test_lane_isolation_across_rung_switch(self, serve_world):
+        """A mid-stream rung switch migrates live sessions into the new
+        slot array; the tracked slot-0 session must still play the
+        exact same game solo vs inside a churning crowd — migration
+        (SessionSlots.migrate) preserves every lane's state."""
+        env, fe, net, mcts = serve_world
+        reset_key = jax.random.PRNGKey(42)
+        dispatch_keys = [jax.random.PRNGKey(100 + i) for i in range(10)]
+        solo = drive_session(
+            PolicyService(env, fe, net, mcts, slots=SLOTS, ladder="8,16"),
+            reset_key, dispatch_keys, churn=False, switch=(3, 16),
+        )
+        crowded = drive_session(
+            PolicyService(env, fe, net, mcts, slots=SLOTS, ladder="8,16"),
+            reset_key, dispatch_keys, churn=True, switch=(3, 16),
+        )
+        assert solo == crowded
+
+    def test_rung_switch_invalidates_carried_trees(self, serve_world):
+        """A promoted subtree's static shape belongs to its bucket:
+        switching rungs must drop every carried tree (`_carry_ok` all
+        False at the new width) while live sessions keep identity."""
+        from alphatriangle_tpu.config import AlphaTriangleMCTSConfig
+
+        env, fe, net, _mcts = serve_world
+        reuse_cfg = AlphaTriangleMCTSConfig(
+            max_simulations=8, max_depth=4, mcts_batch_size=4,
+            tree_reuse=True,
+        )
+        mcts = BatchedMCTS(env, fe, net.model, reuse_cfg, net.support)
+        service = PolicyService(
+            env, fe, net, mcts, slots=SLOTS, ladder="8,16"
+        )
+        sessions = service.open_sessions(
+            jax.random.split(jax.random.PRNGKey(5), 3)
+        )
+        for _ in range(2):
+            for s in sessions:
+                service.request_move(s.sid)
+            service.dispatch()
+        assert service._carry_ok.any()  # trees are being carried
+        service._switch_rung(16, "test")
+        assert service.sessions.slots == 16
+        assert service._carry_ok.shape == (16,)
+        assert not service._carry_ok.any()  # all invalidated
+        # Identity preserved: same sids, slots re-packed lowest-first.
+        live = sorted(service.sessions.live_sessions(), key=lambda s: s.slot)
+        assert [s.sid for s in live] == [s.sid for s in sessions]
+        # And the service still serves at the new rung.
+        for s in sessions:
+            service.request_move(s.sid)
+        results = service.dispatch()
+        assert len(results) == 3
+        for s in sessions:
+            service.close_session(s.sid)
 
 
 class TestConcurrentDrain:
